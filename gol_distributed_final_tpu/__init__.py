@@ -1,0 +1,66 @@
+"""gol_distributed_final_tpu — a TPU-native distributed Game of Life framework.
+
+A ground-up JAX/XLA re-founding of the capabilities of the reference system
+(ao22174/Gol-distributed-final: a Go controller/broker/worker cluster over
+net/rpc). The compute plane is a single device-resident ``jnp.uint8[H, W]``
+board evolved by a fused, jitted 3x3 toroidal stencil — sharded over a device
+mesh with ``shard_map`` + ``lax.ppermute`` halo exchange where the reference
+fanned full-board copies to Go workers (reference: broker/broker.go:135-224).
+The control plane (run / pause / quit / snapshot, the 2-second alive-count
+ticker, PGM image IO, and the typed event stream) preserves the reference's
+observable contract (reference: stubs/stubs.go, gol/event.go, gol/io.go).
+
+Package layout:
+    ops/       jitted stencil kernels (roll-based, pallas), reductions
+    models/    life-like automaton rule family (B/S rulestrings); Conway flagship
+    parallel/  device meshes, shard_map halo-exchange steps, multi-host helpers
+    engine/    the GoL engine (broker equivalent) + controller (distributor)
+    io/        PGM P5 codec, images/ -> out/ conventions, streamed shard IO
+    events/    the 6-event observability stream
+    rpc/       TCP control plane preserving the stubs/ method vocabulary
+    viz/       visualiser (SDL-equivalent) with headless fallback
+    utils/     Cell, board visualisation for test failures
+"""
+
+from .params import Params
+from .events import (
+    AliveCellsCount,
+    CellFlipped,
+    Event,
+    FinalTurnComplete,
+    ImageOutputComplete,
+    State,
+    StateChange,
+    TurnComplete,
+)
+from .utils.cell import Cell
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Params",
+    "Cell",
+    "Event",
+    "AliveCellsCount",
+    "ImageOutputComplete",
+    "StateChange",
+    "CellFlipped",
+    "TurnComplete",
+    "FinalTurnComplete",
+    "State",
+    "run",
+    "__version__",
+]
+
+
+def run(params, events=None, keypresses=None, **kwargs):
+    """Run a full Game of Life session (the ``gol.Run`` equivalent).
+
+    Lazy import so that ``import gol_distributed_final_tpu`` stays cheap and
+    does not pull in JAX until compute is actually requested.
+
+    Reference: gol/gol.go:12-41.
+    """
+    from .engine.controller import run as _run
+
+    return _run(params, events=events, keypresses=keypresses, **kwargs)

@@ -1,0 +1,118 @@
+"""The typed event stream — the framework's observability layer.
+
+Mirrors the reference's event vocabulary and string formats exactly
+(reference: gol/event.go:9-131): six concrete events, of which
+``CellFlipped`` / ``TurnComplete`` / ``FinalTurnComplete`` stringify to ""
+(render-only — consumed by the visualiser and tests, never printed), and the
+other three print via the ``Completed Turns <n> <event>`` convention of the
+SDL loop (reference: sdl/loop.go:44-47).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import List
+
+from ..utils.cell import Cell
+
+
+class State(enum.IntEnum):
+    """Execution state (reference: gol/event.go:31-38, 71-82)."""
+
+    PAUSED = 0
+    EXECUTING = 1
+    QUITTING = 2
+
+    def __str__(self) -> str:
+        return {
+            State.PAUSED: "Paused",
+            State.EXECUTING: "Executing",
+            State.QUITTING: "Quitting",
+        }.get(self, "Incorrect State")
+
+
+# Aliases matching the reference constant names (gol/event.go:34-38).
+Paused = State.PAUSED
+Executing = State.EXECUTING
+Quitting = State.QUITTING
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    """Base event: every event carries the number of fully completed turns
+    (if the 0th turn is finished, this is 1 — gol/event.go:12-14)."""
+
+    completed_turns: int
+
+    def get_completed_turns(self) -> int:
+        return self.completed_turns
+
+    def __str__(self) -> str:
+        return ""
+
+
+@dataclasses.dataclass(frozen=True)
+class AliveCellsCount(Event):
+    """Sent every 2 s with the live cell total (gol/event.go:19-22)."""
+
+    cells_count: int = 0
+
+    def __str__(self) -> str:
+        return f"Alive Cells {self.cells_count}"
+
+
+@dataclasses.dataclass(frozen=True)
+class ImageOutputComplete(Event):
+    """Sent after each PGM image is saved (gol/event.go:26-29)."""
+
+    filename: str = ""
+
+    def __str__(self) -> str:
+        return f"File {self.filename} output complete"
+
+
+@dataclasses.dataclass(frozen=True)
+class StateChange(Event):
+    """Sent on pause / resume / quit (gol/event.go:40-45)."""
+
+    new_state: State = State.EXECUTING
+
+    def __str__(self) -> str:
+        return str(self.new_state)
+
+
+@dataclasses.dataclass(frozen=True)
+class CellFlipped(Event):
+    """One cell changed state; render-only (gol/event.go:50-53).
+    All flips for a turn must be sent *before* that turn's TurnComplete."""
+
+    cell: Cell = Cell(0, 0)
+
+
+@dataclasses.dataclass(frozen=True)
+class TurnComplete(Event):
+    """Turn boundary; the visualiser renders a frame (gol/event.go:58-60)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FinalTurnComplete(Event):
+    """Execution finished; ``alive`` is the payload the tests assert on
+    (gol/event.go:65-68)."""
+
+    alive: List[Cell] = dataclasses.field(default_factory=list)
+
+
+__all__ = [
+    "Event",
+    "State",
+    "Paused",
+    "Executing",
+    "Quitting",
+    "AliveCellsCount",
+    "ImageOutputComplete",
+    "StateChange",
+    "CellFlipped",
+    "TurnComplete",
+    "FinalTurnComplete",
+]

@@ -1,0 +1,3 @@
+from .life import CONWAY, DAY_AND_NIGHT, HIGHLIFE, SEEDS, LifeRule
+
+__all__ = ["LifeRule", "CONWAY", "HIGHLIFE", "SEEDS", "DAY_AND_NIGHT"]

@@ -1,0 +1,83 @@
+"""The life-like automaton model family.
+
+The reference hard-codes Conway's rule inside its worker kernel
+(worker/worker.go:41-46). Here the rule is a first-class model: any
+totalistic life-like automaton expressed as a B.../S... rulestring, compiled
+to static 9-bit masks that the jitted stencil consumes (ops/stencil.py).
+``CONWAY`` is the flagship model — the one the goldens, the benchmark, and
+``__graft_entry__`` exercise.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import re
+
+import jax
+
+from ..ops import stencil
+
+
+def _mask(counts) -> int:
+    m = 0
+    for c in counts:
+        if not 0 <= c <= 8:
+            raise ValueError(f"neighbour count out of range: {c}")
+        m |= 1 << c
+    return m
+
+
+@dataclasses.dataclass(frozen=True)
+class LifeRule:
+    """A totalistic life-like rule, e.g. Conway = B3/S23."""
+
+    name: str
+    birth_mask: int
+    survive_mask: int
+
+    @classmethod
+    def from_rulestring(cls, rulestring: str, name: str | None = None) -> "LifeRule":
+        m = re.fullmatch(r"B(\d*)/S(\d*)", rulestring.strip(), re.IGNORECASE)
+        if m is None:
+            raise ValueError(f"not a B/S rulestring: {rulestring!r}")
+        birth = [int(ch) for ch in m.group(1)]
+        survive = [int(ch) for ch in m.group(2)]
+        return cls(
+            name=name or rulestring.upper(),
+            birth_mask=_mask(birth),
+            survive_mask=_mask(survive),
+        )
+
+    @property
+    def rulestring(self) -> str:
+        birth = "".join(str(i) for i in range(9) if self.birth_mask >> i & 1)
+        survive = "".join(str(i) for i in range(9) if self.survive_mask >> i & 1)
+        return f"B{birth}/S{survive}"
+
+    def step(self, board: jax.Array) -> jax.Array:
+        """One jitted turn under this rule."""
+        return stencil.step(
+            board, birth_mask=self.birth_mask, survive_mask=self.survive_mask
+        )
+
+    def step_n(self, board: jax.Array, n: int) -> jax.Array:
+        """``n`` turns in one device dispatch."""
+        return stencil.step_n(
+            board, n, birth_mask=self.birth_mask, survive_mask=self.survive_mask
+        )
+
+    def step_fn(self):
+        """A plain ``board -> board`` closure with the masks baked in, for
+        wrapping in jit/shard_map by callers (parallel/halo.py, bench)."""
+        return functools.partial(
+            stencil.step,
+            birth_mask=self.birth_mask,
+            survive_mask=self.survive_mask,
+        )
+
+
+CONWAY = LifeRule.from_rulestring("B3/S23", name="conway")
+HIGHLIFE = LifeRule.from_rulestring("B36/S23", name="highlife")
+SEEDS = LifeRule.from_rulestring("B2/S", name="seeds")
+DAY_AND_NIGHT = LifeRule.from_rulestring("B3678/S34678", name="day-and-night")

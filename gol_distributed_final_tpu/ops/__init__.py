@@ -1,0 +1,12 @@
+from .stencil import ALIVE, DEAD, neighbour_counts, step, step_n
+from .reduce import alive_count, alive_cells
+
+__all__ = [
+    "ALIVE",
+    "DEAD",
+    "neighbour_counts",
+    "step",
+    "step_n",
+    "alive_count",
+    "alive_cells",
+]

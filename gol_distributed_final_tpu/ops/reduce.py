@@ -1,0 +1,30 @@
+"""Alive-cell reductions (reference: broker/broker.go:47-58, ``calculateAliveCells``).
+
+Two consumers with different shapes:
+  * ``alive_count`` — the scalar behind the 2-second ``AliveCellsCount`` event;
+    a device-side reduction so the ticker never copies the board to host.
+  * ``alive_cells`` — the ``[]util.Cell`` payload of ``FinalTurnComplete``;
+    inherently host-side (variable length), produced row-major like the
+    reference's nested loop so orderings agree byte-for-byte.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.cell import Cell
+
+
+@jax.jit
+def alive_count(board: jax.Array) -> jax.Array:
+    """Number of alive cells as a device scalar (int32)."""
+    return jnp.sum(board != 0, dtype=jnp.int32)
+
+
+def alive_cells(board) -> list[Cell]:
+    """Coordinates of alive cells as ``Cell(x, y)``, row-major."""
+    arr = np.asarray(board)
+    ys, xs = np.nonzero(arr)
+    return [Cell(int(x), int(y)) for x, y in zip(xs, ys)]

@@ -1,0 +1,29 @@
+"""Run parameters — the ``gol.Params`` equivalent (reference: gol/gol.go:4-9).
+
+The reference conflates width/height in several allocations but is only ever
+exercised on square boards (SURVEY.md §5 quirks). We implement true H x W
+semantics: the board array is ``[height, width]``, a ``Cell`` is ``(x, y)`` =
+(column, row), matching reference util/cell.go.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass(frozen=True)
+class Params:
+    turns: int
+    threads: int = 8
+    image_width: int = 512
+    image_height: int = 512
+
+    @property
+    def input_filename(self) -> str:
+        # "<W>x<H>" — load-bearing naming convention (gol/distributor.go:144)
+        return f"{self.image_width}x{self.image_height}"
+
+    @property
+    def output_filename(self) -> str:
+        # "<W>x<H>x<Turns>" (gol/distributor.go:165)
+        return f"{self.image_width}x{self.image_height}x{self.turns}"

@@ -1,0 +1,12 @@
+"""The canonical alive-cell coordinate (reference: util/cell.go:4-6).
+
+``x`` is the column index, ``y`` the row index — the payload type of
+``FinalTurnComplete.alive`` and what the golden-image tests assert on.
+"""
+
+from typing import NamedTuple
+
+
+class Cell(NamedTuple):
+    x: int
+    y: int

@@ -1,0 +1,49 @@
+"""Test harness configuration.
+
+Forces JAX onto the CPU backend with 8 virtual devices BEFORE jax is imported
+anywhere, so the multi-device sharding paths (parallel/halo.py) are exercised
+on a virtual mesh exactly as the driver's dryrun does. Real-TPU behavior is
+covered by bench.py, not the test suite.
+"""
+
+import os
+import pathlib
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def repo_root() -> pathlib.Path:
+    return REPO_ROOT
+
+
+@pytest.fixture(scope="session")
+def images_dir(repo_root) -> pathlib.Path:
+    return repo_root / "images"
+
+
+@pytest.fixture(scope="session")
+def check_dir(repo_root) -> pathlib.Path:
+    return repo_root / "check"
+
+
+@pytest.fixture()
+def out_dir(tmp_path, monkeypatch) -> pathlib.Path:
+    """Each test writes PGM output into its own tmp 'out/' directory by
+    chdir-ing there, mirroring the reference's cwd-relative 'out/' convention
+    (gol/io.go:42-44) without polluting the repo."""
+    monkeypatch.chdir(tmp_path)
+    # the reference reads images/ relative to cwd too; link the fixtures in
+    (tmp_path / "images").symlink_to(REPO_ROOT / "images")
+    return tmp_path / "out"

@@ -1,0 +1,41 @@
+"""Independent pure-NumPy oracle for life-like automata, used only by tests.
+
+Deliberately implemented differently from both the framework's XLA stencil
+and the reference's Go kernel: modular index arithmetic over an explicit
+neighbour loop, no rolls, no masks.
+"""
+
+import numpy as np
+
+
+def naive_step(board: np.ndarray, birth=(3,), survive=(2, 3)) -> np.ndarray:
+    h, w = board.shape
+    out = np.zeros_like(board)
+    for y in range(h):
+        for x in range(w):
+            n = 0
+            for dy in (-1, 0, 1):
+                for dx in (-1, 0, 1):
+                    if dy == 0 and dx == 0:
+                        continue
+                    if board[(y + dy) % h, (x + dx) % w] != 0:
+                        n += 1
+            if board[y, x] != 0:
+                out[y, x] = 255 if n in survive else 0
+            else:
+                out[y, x] = 255 if n in birth else 0
+    return out
+
+
+def vector_step(board: np.ndarray, birth=(3,), survive=(2, 3)) -> np.ndarray:
+    """Faster vectorised oracle (np.roll) for multi-turn parity runs."""
+    ones = (board != 0).astype(np.int32)
+    n = np.zeros_like(ones)
+    for dy in (-1, 0, 1):
+        for dx in (-1, 0, 1):
+            if (dy, dx) == (0, 0):
+                continue
+            n += np.roll(ones, (dy, dx), axis=(0, 1))
+    alive = board != 0
+    nxt = np.where(alive, np.isin(n, survive), np.isin(n, birth))
+    return np.where(nxt, 255, 0).astype(np.uint8)

@@ -1,0 +1,116 @@
+"""Unit tests for the core stencil ops against an independent NumPy oracle."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from gol_distributed_final_tpu.models import CONWAY, DAY_AND_NIGHT, HIGHLIFE, SEEDS
+from gol_distributed_final_tpu.ops import (
+    alive_cells,
+    alive_count,
+    neighbour_counts,
+    step,
+    step_n,
+)
+from gol_distributed_final_tpu.utils import Cell
+
+from oracle import naive_step, vector_step
+
+
+def random_board(h, w, seed=0, density=0.3):
+    rng = np.random.default_rng(seed)
+    return np.where(rng.random((h, w)) < density, 255, 0).astype(np.uint8)
+
+
+def test_neighbour_counts_blinker():
+    board = np.zeros((5, 5), np.uint8)
+    board[2, 1:4] = 255  # horizontal blinker
+    n = np.asarray(neighbour_counts(jnp.asarray(board)))
+    assert n[2, 2] == 2  # centre sees its two arms
+    assert n[1, 2] == 3 and n[3, 2] == 3  # birth sites above/below centre
+    assert n[2, 1] == 1 and n[2, 3] == 1
+
+
+def test_blinker_oscillates():
+    board = np.zeros((5, 5), np.uint8)
+    board[2, 1:4] = 255
+    one = np.asarray(step(jnp.asarray(board)))
+    expected = np.zeros((5, 5), np.uint8)
+    expected[1:4, 2] = 255  # vertical phase
+    np.testing.assert_array_equal(one, expected)
+    two = np.asarray(step(jnp.asarray(one)))
+    np.testing.assert_array_equal(two, board)
+
+
+def test_toroidal_wrap_glider_crosses_edge():
+    # glider at the corner must wrap, like worker/worker.go:48-63's edge cases
+    board = np.zeros((8, 8), np.uint8)
+    for x, y in [(1, 0), (2, 1), (0, 2), (1, 2), (2, 2)]:
+        board[y, x] = 255
+    b = board
+    for _ in range(4 * 8):  # a glider translates by (1,1) every 4 turns
+        b = np.asarray(step(jnp.asarray(b)))
+    np.testing.assert_array_equal(b, board)
+
+
+@pytest.mark.parametrize("shape", [(1, 1), (2, 3), (5, 5), (16, 16), (17, 13), (64, 64)])
+def test_step_matches_naive_oracle(shape):
+    board = random_board(*shape, seed=shape[0] * 100 + shape[1])
+    got = np.asarray(step(jnp.asarray(board)))
+    want = naive_step(board)
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize(
+    "rule,birth,survive",
+    [
+        (CONWAY, (3,), (2, 3)),
+        (HIGHLIFE, (3, 6), (2, 3)),
+        (SEEDS, (2,), ()),
+        (DAY_AND_NIGHT, (3, 6, 7, 8), (3, 4, 6, 7, 8)),
+    ],
+)
+def test_rule_family_matches_oracle(rule, birth, survive):
+    board = random_board(32, 32, seed=7)
+    got = np.asarray(rule.step(jnp.asarray(board)))
+    want = naive_step(board, birth=birth, survive=survive)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_rulestring_roundtrip():
+    assert CONWAY.rulestring == "B3/S23"
+    assert HIGHLIFE.rulestring == "B36/S23"
+    assert SEEDS.rulestring == "B2/S"
+
+
+def test_step_n_equals_repeated_step():
+    board = random_board(32, 48, seed=3)
+    chunk = np.asarray(step_n(jnp.asarray(board), 17))
+    b = board
+    for _ in range(17):
+        b = vector_step(b)
+    np.testing.assert_array_equal(chunk, b)
+
+
+def test_step_n_zero_is_identity():
+    board = random_board(8, 8, seed=1)
+    np.testing.assert_array_equal(np.asarray(step_n(jnp.asarray(board), 0)), board)
+
+
+def test_alive_reductions():
+    board = np.zeros((4, 6), np.uint8)
+    board[0, 1] = 255
+    board[3, 5] = 255
+    board[2, 0] = 255
+    assert int(alive_count(jnp.asarray(board))) == 3
+    cells = alive_cells(board)
+    assert set(cells) == {Cell(1, 0), Cell(0, 2), Cell(5, 3)}
+    # row-major like broker/broker.go:47-58
+    assert cells == [Cell(1, 0), Cell(0, 2), Cell(5, 3)]
+
+
+def test_values_stay_0_or_255():
+    board = random_board(16, 16, seed=9)
+    out = np.asarray(step_n(jnp.asarray(board), 5))
+    assert set(np.unique(out)).issubset({0, 255})
