@@ -1,0 +1,3 @@
+from .engine import Engine, RunResult, Snapshot
+
+__all__ = ["Engine", "RunResult", "Snapshot"]

@@ -1,0 +1,218 @@
+"""The controller — the reference distributor + ticker, re-founded on queues.
+
+Mirrors gol/distributor.go behavior exactly at the event level:
+
+* load ``images/<W>x<H>.pgm``, make the blocking Run call, then emit
+  ``FinalTurnComplete`` -> write ``out/<W>x<H>x<Turns>.pgm`` ->
+  ``ImageOutputComplete`` -> ``StateChange{Quitting}`` -> close the stream
+  (gol/distributor.go:131-185);
+* a ticker thread that every 2 s retrieves a snapshot and emits
+  ``AliveCellsCount`` (suppressed while paused) and that dispatches
+  keypresses with the reference's exact semantics — including the
+  ``TurnsCompleted - 1`` quirk on resume (gol/distributor.go:118)
+  (gol/distributor.go:25-129).
+
+The events channel is a ``queue.Queue``; stream end is signalled by the
+``CLOSED`` sentinel (the Go ``close(events)`` equivalent). ``iter_events``
+adapts a queue to a plain iterator for consumers and tests.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+from ..events import (
+    AliveCellsCount,
+    FinalTurnComplete,
+    ImageOutputComplete,
+    Quitting,
+    StateChange,
+    State,
+)
+from ..io.pgm import read_board, write_board
+from ..models import CONWAY
+from .engine import Engine, EngineConfig, RunResult
+
+CLOSED = object()
+"""Sentinel marking the end of an event stream (Go's close(events))."""
+
+
+def iter_events(q: "queue.Queue", timeout: float | None = None):
+    """Drain an event queue until the CLOSED sentinel.
+
+    ``timeout`` bounds the wait for each *individual* event; if it expires,
+    ``queue.Empty`` propagates (a stalled producer is a bug worth surfacing,
+    not silently ending the stream). ``timeout=None`` blocks indefinitely.
+    """
+    while True:
+        ev = q.get(timeout=timeout)  # timeout=None blocks, like Go's <-ch
+        if ev is CLOSED:
+            return
+        yield ev
+
+
+class InProcessBroker:
+    """The broker surface (stubs/stubs.go verbs) served by a same-process
+    Engine — the default backend when no remote server is given."""
+
+    def __init__(self, engine: Engine | None = None):
+        self.engine = engine or Engine()
+
+    def run(self, params, world, *, emit=None, emit_flips=False) -> RunResult:
+        return self.engine.run(params, world, emit=emit, emit_flips=emit_flips)
+
+    def pause(self):
+        return self.engine.pause()
+
+    def quit(self):
+        return self.engine.quit()
+
+    def super_quit(self):
+        return self.engine.super_quit()
+
+    def retrieve(self, include_world: bool = True):
+        return self.engine.retrieve(include_world=include_world)
+
+
+class _Ticker:
+    """The tickerFunc equivalent (gol/distributor.go:25-129): one thread
+    multiplexing the 2 s tick, the keypress stream, and shutdown."""
+
+    _POLL = 0.02
+
+    def __init__(self, params, events, keypresses, broker, out_dir, tick_seconds):
+        self.params = params
+        self.events = events
+        self.keypresses = keypresses
+        self.broker = broker
+        self.out_dir = out_dir
+        self.tick_seconds = tick_seconds
+        self.done = threading.Event()
+        self.paused = False
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self):
+        self.done.set()
+        self._thread.join()
+
+    def _snapshot_to_pgm(self):
+        snap = self.broker.retrieve()
+        write_board(snap.world, self.params.output_filename, self.out_dir)
+        return snap
+
+    def _loop(self):
+        next_tick = time.monotonic() + self.tick_seconds
+        while not self.done.is_set():
+            key = None
+            if self.keypresses is not None:
+                try:
+                    key = self.keypresses.get_nowait()
+                except queue.Empty:
+                    key = None
+            if key is not None:
+                self._handle_key(key)
+                continue
+            if time.monotonic() >= next_tick:
+                next_tick += self.tick_seconds
+                # count-only snapshot: a device-side reduction, no full-board
+                # device->host copy on the tick path
+                snap = self.broker.retrieve(include_world=False)
+                if not self.paused and not self.done.is_set():
+                    self.events.put(
+                        AliveCellsCount(snap.turns_completed, snap.alive_count)
+                    )
+                continue
+            time.sleep(self._POLL)
+
+    def _handle_key(self, key):
+        # gol/distributor.go:61-122
+        if key == "q":
+            snap = self._snapshot_to_pgm()
+            self.events.put(StateChange(snap.turns_completed, Quitting))
+            self.done.set()
+            self.broker.quit()
+        elif key == "s":
+            print(self.params.output_filename)
+            self._snapshot_to_pgm()
+        elif key == "k":
+            snap = self._snapshot_to_pgm()
+            self.events.put(StateChange(snap.turns_completed, Quitting))
+            self.done.set()
+            self.broker.super_quit()
+        elif key == "p":
+            snap = self.broker.retrieve(include_world=False)
+            if not self.paused:
+                self.events.put(StateChange(snap.turns_completed, State.PAUSED))
+                self.broker.pause()
+                self.paused = True
+            else:
+                # the reference reports one turn fewer on resume
+                # (gol/distributor.go:118) — preserved for parity
+                self.events.put(
+                    StateChange(snap.turns_completed - 1, State.EXECUTING)
+                )
+                self.broker.pause()
+                self.paused = False
+
+
+def run(
+    params,
+    events: "queue.Queue | None" = None,
+    keypresses: "queue.Queue | None" = None,
+    *,
+    broker=None,
+    rule=CONWAY,
+    engine_config: EngineConfig | None = None,
+    emit_flips: bool = False,
+    images_dir="images",
+    out_dir="out",
+    tick_seconds: float = 2.0,
+) -> RunResult:
+    """Run a full Game of Life session (gol.Run + distributor, gol/gol.go:12).
+
+    Blocking; returns the engine's RunResult. Events are pushed to ``events``
+    (created if None), ending with the CLOSED sentinel. ``keypresses`` is an
+    optional queue of single-character commands ('s', 'q', 'k', 'p').
+
+    ``broker`` selects the backend: None for an in-process engine, or any
+    object with the stubs verb surface (e.g. rpc.client.RemoteBroker).
+    """
+    if events is None:
+        events = queue.Queue()
+    if engine_config is None:
+        engine_config = EngineConfig(rule=rule)
+    if broker is None:
+        broker = InProcessBroker(Engine(engine_config))
+
+    ticker = None
+    try:
+        world = read_board(params, images_dir)
+        ticker = _Ticker(params, events, keypresses, broker, out_dir, tick_seconds)
+        ticker.start()
+        result = broker.run(
+            params,
+            world,
+            emit=events.put if emit_flips else None,
+            emit_flips=emit_flips,
+        )
+        # join the ticker BEFORE the closing sequence so no stray
+        # AliveCellsCount can interleave after StateChange{Quitting}
+        ticker.stop()
+        events.put(FinalTurnComplete(result.turns_completed, result.alive))
+        write_board(result.world, params.output_filename, out_dir)
+        events.put(
+            ImageOutputComplete(result.turns_completed, params.output_filename)
+        )
+        events.put(StateChange(result.turns_completed, Quitting))
+        return result
+    finally:
+        if ticker is not None:
+            ticker.done.set()
+        # the stream must always terminate, even on error — a consumer
+        # blocked on iter_events would otherwise hang forever
+        events.put(CLOSED)

@@ -1,0 +1,260 @@
+"""The GoL engine — the broker's ``Operations`` service re-founded on a
+device-resident board.
+
+The reference broker runs a host-side per-turn loop that re-ships the full
+board to every worker over TCP each turn and gathers strips back
+(broker/broker.go:62-234). Here the board never leaves the device during
+compute: the engine dispatches *chunks* of turns as single compiled
+``lax.fori_loop`` programs (ops/stencil.step_n) and services control traffic
+— pause / quit / snapshot, the semantics of broker/broker.go:236-277 —
+between dispatches. Chunks grow by doubling (bounded compile count) and are
+capped by a wall-clock target so the 2-second alive-count cadence and the
+5-second first-report liveness bound (count_test.go:30-38) hold regardless
+of board size.
+
+Concurrency model: ``run`` executes on the caller's thread; ``pause`` /
+``quit`` / ``super_quit`` / ``retrieve`` may be called from any other thread
+(the controller's ticker, an RPC handler). The board snapshot is guarded by
+a lock, like the broker's ``cWorld``/``cTurn`` under ``mt sync.Mutex``
+(broker/broker.go:32-36).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable, List, NamedTuple, Optional
+
+import numpy as np
+
+from ..events import CellFlipped, TurnComplete
+from ..models import CONWAY, LifeRule
+from ..ops import alive_cells
+from ..utils.cell import Cell
+
+
+class Snapshot(NamedTuple):
+    """What ``RetrieveCurrentData`` returns (broker/broker.go:256-277).
+    ``world`` is None for count-only snapshots (retrieve(include_world=False))."""
+
+    world: Optional[np.ndarray]
+    turns_completed: int
+    alive_count: int
+
+    @property
+    def alive(self) -> List[Cell]:
+        return [] if self.world is None else alive_cells(self.world)
+
+
+class RunResult(NamedTuple):
+    """What ``Operations.Run`` returns (broker/broker.go:228-230)."""
+
+    turns_completed: int
+    world: np.ndarray
+    alive: List[Cell]
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    rule: LifeRule = CONWAY
+    # chunking: double from 1 up to max_chunk, but stop growing once a
+    # dispatch exceeds target_dispatch_seconds (keeps control latency low)
+    max_chunk: int = 4096
+    target_dispatch_seconds: float = 0.25
+    # optional override: a board -> board step (e.g. a sharded halo step from
+    # parallel/halo.py, or the pallas kernel); must preserve dtype/shape
+    step_n_fn: Optional[Callable] = None  # (board, n) -> board
+
+
+class Engine:
+    """Evolves one board; serves Run/Pause/Quit/SuperQuit/Retrieve."""
+
+    def __init__(self, config: EngineConfig | None = None):
+        self.config = config or EngineConfig()
+        self._lock = threading.Lock()
+        self._control = threading.Condition(self._lock)
+        self._board_dev = None  # device array, owned by the run loop
+        self._world_host: np.ndarray | None = None  # last synced host copy
+        self._host_dirty = False
+        self._turn = 0
+        self._paused = False
+        self._parked = False  # run loop is actually waiting in the pause gate
+        self._quit = False
+        self._super_quit = False
+        self._running = False
+
+    # -- compute ----------------------------------------------------------
+
+    def _step_n(self, board, n: int):
+        if self.config.step_n_fn is not None:
+            return self.config.step_n_fn(board, n)
+        return self.config.rule.step_n(board, n)
+
+    def _sync_host(self):
+        """Refresh the host snapshot from the device board (under lock)."""
+        if self._host_dirty and self._board_dev is not None:
+            self._world_host = np.asarray(self._board_dev)
+            self._host_dirty = False
+
+    # -- Operations.Run (broker/broker.go:62-234) -------------------------
+
+    def run(
+        self,
+        params,
+        world: np.ndarray,
+        *,
+        emit: Optional[Callable] = None,
+        emit_flips: bool = False,
+    ) -> RunResult:
+        """Blocking: evolve ``world`` for ``params.turns`` turns (or until
+        quit). Resets the turn counter — a reattaching controller starts a
+        fresh run, the reference's detach/reattach semantics (README.md:187,
+        broker/broker.go:64).
+
+        With ``emit_flips`` (single-host visualiser mode) every turn emits
+        ``CellFlipped`` for each changed cell before ``TurnComplete``
+        (gol/event.go:50-60) — including the initial flips for cells alive
+        in the loaded image.
+        """
+        import jax.numpy as jnp
+
+        world = np.asarray(world, np.uint8)
+        with self._lock:
+            if self._running:
+                raise RuntimeError("engine is already running")
+            self._running = True
+            self._board_dev = jnp.asarray(world)
+            self._world_host = world
+            self._host_dirty = False
+            self._turn = 0
+            # _quit/_paused are NOT reset here: a quit() or pause() issued
+            # after the controller started its ticker but before the run
+            # loop initialised must still take effect (they are consumed /
+            # cleared when this run ends)
+
+        try:
+            if emit_flips and emit is not None:
+                for c in alive_cells(world):
+                    emit(CellFlipped(0, c))
+            chunk = 1
+            while True:
+                with self._lock:
+                    while self._paused and not self._quit:
+                        self._parked = True
+                        self._control.notify_all()
+                        self._control.wait()
+                    self._parked = False
+                    if self._quit or self._turn >= params.turns:
+                        break
+                    n = min(chunk, params.turns - self._turn)
+                    if emit_flips:
+                        n = 1
+                    board = self._board_dev
+
+                t0 = time.monotonic()
+                new_board = self._step_n(board, n)
+                new_board.block_until_ready()
+                elapsed = time.monotonic() - t0
+
+                with self._lock:
+                    prev_host = self._world_host if emit_flips else None
+                    self._board_dev = new_board
+                    self._host_dirty = True
+                    self._turn += n
+                    turn_now = self._turn
+                    if emit_flips:
+                        self._sync_host()
+                        new_host = self._world_host
+
+                if emit_flips and emit is not None:
+                    changed = np.nonzero(prev_host != new_host)
+                    for y, x in zip(*changed):
+                        emit(CellFlipped(turn_now, Cell(int(x), int(y))))
+                    emit(TurnComplete(turn_now))
+
+                # grow the chunk while dispatches stay cheap (compile count
+                # is O(log max_chunk) thanks to doubling)
+                if (
+                    not emit_flips
+                    and chunk < self.config.max_chunk
+                    and elapsed < self.config.target_dispatch_seconds
+                ):
+                    chunk *= 2
+
+            with self._lock:
+                self._sync_host()
+                world_out = self._world_host
+                turns_done = self._turn
+            return RunResult(turns_done, world_out, alive_cells(world_out))
+        finally:
+            with self._lock:
+                self._running = False
+                self._paused = False
+                self._quit = False  # consumed; a reattached run starts fresh
+                self._control.notify_all()
+
+    # -- control plane (broker/broker.go:236-277) -------------------------
+
+    def pause(self) -> bool:
+        """Toggle pause; same RPC both pauses and resumes
+        (broker/broker.go:251-254, 83-86, 126-129). Returns new paused state.
+
+        On pause, blocks until the run loop has actually parked (any
+        in-flight chunk has committed), so after pause() returns the board
+        is guaranteed not to advance until resume."""
+        with self._lock:
+            self._paused = not self._paused
+            state = self._paused
+            self._control.notify_all()
+            print("State paused" if state else "State unpaused")
+            if state:
+                while self._running and not self._parked and not self._quit:
+                    self._control.wait(timeout=0.1)
+            return state
+
+    def quit(self):
+        """Break the run loop; the engine object survives and accepts a new
+        ``run`` (broker/broker.go:236-239 + README.md:187)."""
+        with self._lock:
+            self._quit = True
+            self._control.notify_all()
+
+    def super_quit(self):
+        """Coordinated full shutdown (broker/broker.go:241-249). At engine
+        level this is quit + a flag the owning server uses to stop serving."""
+        with self._lock:
+            self._super_quit = True
+            self._quit = True
+            self._control.notify_all()
+
+    @property
+    def super_quit_requested(self) -> bool:
+        with self._lock:
+            return self._super_quit
+
+    def retrieve(self, include_world: bool = True) -> Snapshot:
+        """Mutex-guarded snapshot {World, TurnsCompleted, AliveCount}
+        (broker/broker.go:256-277).
+
+        With ``include_world=False`` (the 2-second ticker's path) the count
+        is a jitted device-side reduction — 4 bytes cross the device
+        boundary instead of the whole board. The reference re-ships the full
+        world on every Retrieve (broker/broker.go:262-270); the TPU-first
+        control plane does not."""
+        from ..ops import alive_count
+
+        with self._lock:
+            turn = self._turn
+            if include_world:
+                self._sync_host()
+                world = self._world_host
+            else:
+                board_dev = self._board_dev
+                world = None
+        if not include_world:
+            count = int(alive_count(board_dev)) if board_dev is not None else 0
+            return Snapshot(world, turn, count)
+        if world is None:
+            world = np.zeros((0, 0), np.uint8)
+        return Snapshot(world, turn, int(np.count_nonzero(world)))

@@ -1,0 +1,19 @@
+from .pgm import (
+    PgmError,
+    PgmReader,
+    PgmWriter,
+    read_board,
+    read_pgm,
+    write_board,
+    write_pgm,
+)
+
+__all__ = [
+    "PgmError",
+    "PgmReader",
+    "PgmWriter",
+    "read_pgm",
+    "write_pgm",
+    "read_board",
+    "write_board",
+]

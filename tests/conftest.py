@@ -38,12 +38,3 @@ def check_dir(repo_root) -> pathlib.Path:
     return repo_root / "check"
 
 
-@pytest.fixture()
-def out_dir(tmp_path, monkeypatch) -> pathlib.Path:
-    """Each test writes PGM output into its own tmp 'out/' directory by
-    chdir-ing there, mirroring the reference's cwd-relative 'out/' convention
-    (gol/io.go:42-44) without polluting the repo."""
-    monkeypatch.chdir(tmp_path)
-    # the reference reads images/ relative to cwd too; link the fixtures in
-    (tmp_path / "images").symlink_to(REPO_ROOT / "images")
-    return tmp_path / "out"

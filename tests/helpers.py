@@ -1,0 +1,35 @@
+"""Shared test helpers — ports of the reference harness support code
+(gol_test.go:58-129, count_test.go:71-89), implemented over this framework's
+own PGM codec."""
+
+import csv
+import pathlib
+
+from gol_distributed_final_tpu.io.pgm import read_pgm
+from gol_distributed_final_tpu.ops import alive_cells
+from gol_distributed_final_tpu.utils import Cell, alive_cells_to_string
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def read_alive_cells(pgm_path) -> set[Cell]:
+    """Alive-cell set parsed from a golden PGM (gol_test.go:88-129)."""
+    return set(alive_cells(read_pgm(pgm_path)))
+
+
+def read_alive_counts(csv_path) -> dict[int, int]:
+    """completed_turns -> alive_cells from a golden CSV (count_test.go:71-89)."""
+    with open(csv_path) as f:
+        rows = csv.DictReader(f)
+        return {int(r["completed_turns"]): int(r["alive_cells"]) for r in rows}
+
+
+def assert_equal_board(given, expected, width, height):
+    """Multiset equality of alive cells, pretty-printed on small-board
+    failure like gol_test.go:42-56."""
+    given, expected = set(given), set(expected)
+    if given != expected:
+        msg = f"{len(given)} alive cells given, {len(expected)} expected"
+        if width <= 16 and height <= 16:
+            msg += "\n" + alive_cells_to_string(given, expected, width, height)
+        raise AssertionError(msg)

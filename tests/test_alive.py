@@ -1,0 +1,131 @@
+"""TestAlive analogue (count_test.go:17-69): the ticker's AliveCellsCount
+events must be exact against the golden per-turn CSV, the first report must
+arrive within the liveness bound, and 'q' must detach cleanly mid-run.
+
+Scaled for CI: 64x64 board, fast tick — the contract (exact counts at the
+reported turn, cadence, quit semantics) is identical to the reference's
+512x512 / 2 s / 100M-turn setup.
+"""
+
+import queue
+import threading
+
+import numpy as np
+
+from gol_distributed_final_tpu import (
+    AliveCellsCount,
+    FinalTurnComplete,
+    Params,
+    StateChange,
+    State,
+)
+from gol_distributed_final_tpu import run
+from gol_distributed_final_tpu.engine.controller import CLOSED
+
+from helpers import REPO_ROOT, read_alive_counts
+
+
+def test_alive_counts_match_golden_csv(tmp_path):
+    counts = read_alive_counts(REPO_ROOT / "check" / "alive" / "64x64.csv")
+    initial_alive = 2819  # not in the CSV: count of images/64x64.pgm at turn 0
+    p = Params(turns=100_000_000, image_width=64, image_height=64)
+    events = queue.Queue()
+    keypresses = queue.Queue()
+
+    done = threading.Event()
+    collected = []
+    errors = []
+
+    def consumer():
+        ticks = 0
+        try:
+            while True:
+                ev = events.get(timeout=30)
+                if ev is CLOSED:
+                    break
+                collected.append(ev)
+                if isinstance(ev, AliveCellsCount):
+                    ticks += 1
+                    if ticks == 5:  # after 5 correct reports, press 'q'
+                        keypresses.put("q")
+        except BaseException as e:  # surface thread failures to pytest
+            errors.append(e)
+            keypresses.put("q")  # unblock the run
+        finally:
+            done.set()
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    result = run(
+        p,
+        events,
+        keypresses,
+        images_dir=REPO_ROOT / "images",
+        out_dir=tmp_path / "out",
+        tick_seconds=0.2,
+    )
+    assert done.wait(timeout=30)
+    t.join()
+    assert not errors, errors
+
+    alive_events = [e for e in collected if isinstance(e, AliveCellsCount)]
+    assert len(alive_events) >= 5, "liveness: ticker must report"
+    for ev in alive_events:
+        expected = (
+            initial_alive
+            if ev.completed_turns == 0
+            else counts[ev.completed_turns]
+        )
+        assert ev.cells_count == expected, (
+            f"turn {ev.completed_turns}: got {ev.cells_count}, want {expected}"
+        )
+
+    # 'q' semantics: StateChange{Quitting} from the ticker, then the normal
+    # closing sequence with turns_completed < requested turns
+    finals = [e for e in collected if isinstance(e, FinalTurnComplete)]
+    assert len(finals) == 1
+    assert 0 < finals[0].completed_turns < p.turns
+    quits = [
+        e
+        for e in collected
+        if isinstance(e, StateChange) and e.new_state == State.QUITTING
+    ]
+    assert len(quits) == 2  # one from 'q', one from the closing sequence
+
+
+def test_first_report_within_liveness_bound(tmp_path):
+    """First AliveCellsCount must arrive within 5 s of start
+    (count_test.go:30-38) even on a large board: chunking must not let a
+    single dispatch starve the ticker."""
+    import time
+
+    p = Params(turns=100_000_000, image_width=512, image_height=512)
+    events = queue.Queue()
+    keypresses = queue.Queue()
+    start = time.monotonic()
+    errors = []
+
+    def watcher():
+        try:
+            while True:
+                ev = events.get(timeout=30)
+                if isinstance(ev, AliveCellsCount):
+                    assert time.monotonic() - start < 5.0, "first report too late"
+                    return
+        except BaseException as e:  # surface thread failures to pytest
+            errors.append(e)
+        finally:
+            keypresses.put("q")  # always unblock the run
+
+    t = threading.Thread(target=watcher)
+    t.start()
+    run(
+        p,
+        events,
+        keypresses,
+        images_dir=REPO_ROOT / "images",
+        out_dir=tmp_path / "out",
+        tick_seconds=2.0,
+    )
+    t.join()
+    assert not errors, errors

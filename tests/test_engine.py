@@ -1,0 +1,188 @@
+"""Engine control-plane tests: pause/resume, quit, detach/reattach, snapshot
+consistency, and the CellFlipped/TurnComplete protocol (the TestSdl contract,
+sdl_test.go:18-116)."""
+
+import queue
+import threading
+import time
+
+import numpy as np
+
+from gol_distributed_final_tpu import (
+    AliveCellsCount,
+    CellFlipped,
+    FinalTurnComplete,
+    Params,
+    StateChange,
+    State,
+    TurnComplete,
+)
+from gol_distributed_final_tpu.engine import Engine
+from gol_distributed_final_tpu.engine.engine import EngineConfig
+from gol_distributed_final_tpu.io.pgm import read_pgm
+from gol_distributed_final_tpu import run
+from gol_distributed_final_tpu.engine.controller import CLOSED
+
+from helpers import REPO_ROOT
+from oracle import vector_step
+
+
+def small_board(seed=0, size=16):
+    rng = np.random.default_rng(seed)
+    return np.where(rng.random((size, size)) < 0.3, 255, 0).astype(np.uint8)
+
+
+def run_in_thread(engine, params, world, **kw):
+    result = {}
+
+    def target():
+        result["run"] = engine.run(params, world, **kw)
+
+    t = threading.Thread(target=target)
+    t.start()
+    return t, result
+
+
+def test_retrieve_snapshot_is_consistent():
+    engine = Engine(EngineConfig(max_chunk=1))
+    world = small_board()
+    p = Params(turns=200, image_width=16, image_height=16)
+    t, result = run_in_thread(engine, p, world)
+    seen = []
+    while t.is_alive():
+        snap = engine.retrieve()
+        seen.append(snap)
+        time.sleep(0.001)
+    t.join()
+    # every snapshot's world must be exactly the oracle's board at that turn
+    boards = {0: world}
+    b = world
+    for i in range(1, 201):
+        b = vector_step(b)
+        boards[i] = b
+    for snap in seen:
+        np.testing.assert_array_equal(snap.world, boards[snap.turns_completed])
+        assert snap.alive_count == int(np.count_nonzero(boards[snap.turns_completed]))
+
+
+def test_pause_stops_progress_and_resume_continues():
+    engine = Engine(EngineConfig(max_chunk=4))
+    p = Params(turns=100_000, image_width=16, image_height=16)
+    t, result = run_in_thread(engine, p, small_board(1))
+    time.sleep(0.3)
+    assert engine.pause() is True
+    turn_a = engine.retrieve().turns_completed
+    time.sleep(0.3)
+    turn_b = engine.retrieve().turns_completed
+    assert turn_b == turn_a  # no progress while paused
+    assert engine.pause() is False
+    time.sleep(0.3)
+    assert engine.retrieve().turns_completed > turn_b
+    engine.quit()
+    t.join(timeout=10)
+    assert not t.is_alive()
+
+
+def test_quit_then_reattach_fresh_run():
+    """'q' detaches the controller; the engine survives and a new Run starts
+    from scratch (README.md:187, broker/broker.go:64)."""
+    engine = Engine(EngineConfig(max_chunk=4))
+    p = Params(turns=100_000, image_width=16, image_height=16)
+    t, result = run_in_thread(engine, p, small_board(2))
+    time.sleep(0.2)
+    engine.quit()
+    t.join(timeout=10)
+    first = result["run"]
+    assert 0 < first.turns_completed < 100_000
+
+    # reattach: fresh run resets the turn counter
+    p2 = Params(turns=3, image_width=16, image_height=16)
+    second = engine.run(p2, small_board(3))
+    assert second.turns_completed == 3
+
+
+def test_zero_turns_board_passthrough():
+    engine = Engine()
+    world = small_board(4)
+    p = Params(turns=0, image_width=16, image_height=16)
+    res = engine.run(p, world)
+    assert res.turns_completed == 0
+    np.testing.assert_array_equal(res.world, world)
+
+
+def test_flip_protocol_reconstructs_every_turn(tmp_path):
+    """TestSdl's contract: applying CellFlipped XORs reproduces the board at
+    every TurnComplete, and flips precede their TurnComplete
+    (sdl_test.go:56-74, gol/event.go:55-57)."""
+    p = Params(turns=8, image_width=16, image_height=16)
+    events = queue.Queue()
+    run(
+        p,
+        events,
+        emit_flips=True,
+        images_dir=REPO_ROOT / "images",
+        out_dir=tmp_path / "out",
+        tick_seconds=3600,
+    )
+    shadow = np.zeros((16, 16), np.uint8)
+    oracle_board = read_pgm(REPO_ROOT / "images" / "16x16.pgm")
+    turn = 0
+    saw_final = False
+    while True:
+        ev = events.get_nowait()
+        if ev is CLOSED:
+            break
+        if isinstance(ev, CellFlipped):
+            x, y = ev.cell
+            shadow[y, x] ^= 255
+        elif isinstance(ev, TurnComplete):
+            turn += 1
+            assert ev.completed_turns == turn
+            oracle_board = vector_step(oracle_board)
+            np.testing.assert_array_equal(shadow, oracle_board)
+        elif isinstance(ev, FinalTurnComplete):
+            saw_final = True
+    assert turn == 8 and saw_final
+
+
+def test_quit_before_run_starts_still_quits():
+    """A 'q' that lands between ticker start and run-loop init must not be
+    discarded: the run should end immediately."""
+    engine = Engine(EngineConfig(max_chunk=4))
+    engine.quit()
+    p = Params(turns=100_000, image_width=16, image_height=16)
+    res = engine.run(p, small_board(7))
+    assert res.turns_completed == 0
+    # and the quit is consumed: a fresh run proceeds normally
+    assert engine.run(Params(turns=2, image_width=16, image_height=16), small_board(7)).turns_completed == 2
+
+
+def test_pause_before_run_starts_run_starts_parked():
+    engine = Engine(EngineConfig(max_chunk=4))
+    engine.pause()  # before any run
+    p = Params(turns=100_000, image_width=16, image_height=16)
+    t, _ = run_in_thread(engine, p, small_board(8))
+    time.sleep(0.3)
+    assert engine.retrieve(include_world=False).turns_completed == 0
+    engine.pause()  # resume
+    time.sleep(0.3)
+    assert engine.retrieve(include_world=False).turns_completed > 0
+    engine.quit()
+    t.join(timeout=10)
+
+
+def test_count_only_snapshot_alive_is_empty():
+    engine = Engine()
+    engine.run(Params(turns=1, image_width=16, image_height=16), small_board(9))
+    snap = engine.retrieve(include_world=False)
+    assert snap.world is None and snap.alive == []
+
+
+def test_super_quit_sets_flag():
+    engine = Engine(EngineConfig(max_chunk=2))
+    p = Params(turns=100_000, image_width=16, image_height=16)
+    t, _ = run_in_thread(engine, p, small_board(5))
+    time.sleep(0.1)
+    engine.super_quit()
+    t.join(timeout=10)
+    assert engine.super_quit_requested

@@ -1,0 +1,71 @@
+"""TestGol + TestPgm analogues: full runs through the public ``run`` API
+asserted against the reference's committed golden fixtures
+(gol_test.go:15-47, pgm_test.go:10-42)."""
+
+import queue
+
+import numpy as np
+import pytest
+
+from gol_distributed_final_tpu import FinalTurnComplete, Params, run
+from gol_distributed_final_tpu.engine.controller import CLOSED
+from gol_distributed_final_tpu.io.pgm import read_pgm
+
+from helpers import REPO_ROOT, assert_equal_board, read_alive_cells
+
+# the reference matrix: {16, 64, 512}^2 x {0, 1, 100} turns (gol_test.go:16-31)
+MATRIX = [(size, turns) for size in (16, 64, 512) for turns in (0, 1, 100)]
+
+
+def run_case(size, turns, tmp_path):
+    p = Params(turns=turns, image_width=size, image_height=size)
+    events = queue.Queue()
+    result = run(
+        p,
+        events,
+        images_dir=REPO_ROOT / "images",
+        out_dir=tmp_path / "out",
+        tick_seconds=3600,  # no ticker noise in golden runs
+    )
+    drained = []
+    while True:
+        ev = events.get_nowait()
+        if ev is CLOSED:
+            break
+        drained.append(ev)
+    return p, result, drained
+
+
+@pytest.mark.parametrize("size,turns", MATRIX)
+def test_gol_final_board(size, turns, tmp_path):
+    p, result, events = run_case(size, turns, tmp_path)
+    finals = [e for e in events if isinstance(e, FinalTurnComplete)]
+    assert len(finals) == 1
+    assert finals[0].completed_turns == turns
+    expected = read_alive_cells(
+        REPO_ROOT / "check" / "images" / f"{size}x{size}x{turns}.pgm"
+    )
+    assert_equal_board(finals[0].alive, expected, size, size)
+
+
+@pytest.mark.parametrize("size,turns", MATRIX)
+def test_pgm_output_bytes(size, turns, tmp_path):
+    p, result, events = run_case(size, turns, tmp_path)
+    written = read_pgm(tmp_path / "out" / f"{p.output_filename}.pgm")
+    golden = read_pgm(REPO_ROOT / "check" / "images" / f"{size}x{size}x{turns}.pgm")
+    np.testing.assert_array_equal(written, golden)
+
+
+def test_event_sequence_tail(tmp_path):
+    """The closing sequence matches gol/distributor.go:161-184:
+    FinalTurnComplete -> ImageOutputComplete -> StateChange{Quitting}."""
+    from gol_distributed_final_tpu import ImageOutputComplete, StateChange, State
+
+    _, _, events = run_case(16, 1, tmp_path)
+    tail = events[-3:]
+    assert isinstance(tail[0], FinalTurnComplete)
+    assert isinstance(tail[1], ImageOutputComplete)
+    assert tail[1].filename == "16x16x1"
+    assert isinstance(tail[2], StateChange)
+    assert tail[2].new_state == State.QUITTING
+    assert str(tail[2]) == "Quitting"

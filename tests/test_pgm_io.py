@@ -1,0 +1,104 @@
+"""PGM codec tests (reference behavior: gol/io.go:42-126)."""
+
+import numpy as np
+import pytest
+
+from gol_distributed_final_tpu import Params
+from gol_distributed_final_tpu.io.pgm import (
+    PgmError,
+    PgmReader,
+    PgmWriter,
+    read_board,
+    read_pgm,
+    write_board,
+    write_pgm,
+)
+
+
+def test_roundtrip(tmp_path):
+    board = np.where(np.random.default_rng(0).random((17, 23)) < 0.5, 255, 0).astype(np.uint8)
+    p = tmp_path / "b.pgm"
+    write_pgm(p, board)
+    np.testing.assert_array_equal(read_pgm(p), board)
+
+
+def test_header_format(tmp_path):
+    board = np.zeros((4, 6), np.uint8)
+    p = tmp_path / "b.pgm"
+    write_pgm(p, board)
+    raw = p.read_bytes()
+    assert raw.startswith(b"P5\n6 4\n255\n")
+    assert len(raw) == len(b"P5\n6 4\n255\n") + 24
+
+
+@pytest.mark.parametrize(
+    "content,msg",
+    [
+        (b"P2\n2 2\n255\n...", "Not a pgm file"),
+        (b"P5\n2 2\n255\n" + bytes(4), None),  # valid
+        (b"P5\n2 2\n254\n" + bytes(4), "Incorrect maxval/bit depth"),
+        (b"junk", "Not a pgm file"),
+        (b"", "Not a pgm file"),
+    ],
+)
+def test_validation_messages(tmp_path, content, msg):
+    p = tmp_path / "x.pgm"
+    p.write_bytes(content)
+    if msg is None:
+        assert read_pgm(p).shape == (2, 2)
+    else:
+        with pytest.raises(PgmError, match=msg):
+            read_pgm(p)
+
+
+def test_dimension_validation(tmp_path):
+    p = tmp_path / "x.pgm"
+    p.write_bytes(b"P5\n3 2\n255\n" + bytes(6))
+    with pytest.raises(PgmError, match="Incorrect width"):
+        read_pgm(p, expect_width=4)
+    with pytest.raises(PgmError, match="Incorrect height"):
+        read_pgm(p, expect_height=4, expect_width=3)
+
+
+def test_comments_in_header(tmp_path):
+    p = tmp_path / "c.pgm"
+    p.write_bytes(b"P5\n# a comment\n2 2\n255\n" + bytes([1, 2, 3, 4]))
+    np.testing.assert_array_equal(read_pgm(p), [[1, 2], [3, 4]])
+
+
+def test_streamed_rows(tmp_path):
+    board = np.arange(64, dtype=np.uint8).reshape(8, 8)
+    p = tmp_path / "s.pgm"
+    write_pgm(p, board)
+    with PgmReader(p) as r:
+        np.testing.assert_array_equal(r.read_rows(2, 5), board[2:5])
+        np.testing.assert_array_equal(r.read_rows(0, 0), board[0:0])
+        with pytest.raises(PgmError):
+            r.read_rows(5, 9)
+
+
+def test_streamed_writer_enforces_shape(tmp_path):
+    p = tmp_path / "w.pgm"
+    with pytest.raises(PgmError, match="wrote 2 rows"):
+        with PgmWriter(p, width=4, height=3) as w:
+            w.write_rows(np.zeros((2, 4), np.uint8))
+    with pytest.raises(PgmError, match="does not match width"):
+        with PgmWriter(tmp_path / "w2.pgm", width=4, height=3) as w:
+            w.write_rows(np.zeros((3, 5), np.uint8))
+
+
+def test_board_conventions(tmp_path, images_dir):
+    # images/<W>x<H>.pgm in, out/<W>x<H>x<T>.pgm out (gol/distributor.go:144,165)
+    p = Params(turns=7, image_width=16, image_height=16)
+    board = read_board(p, images_dir)
+    assert board.shape == (16, 16)
+    out = write_board(board, p.output_filename, tmp_path / "out")
+    assert out.name == "16x16x7.pgm"
+    np.testing.assert_array_equal(read_pgm(out), board)
+
+
+def test_truncated_raster(tmp_path):
+    p = tmp_path / "t.pgm"
+    p.write_bytes(b"P5\n4 4\n255\n" + bytes(10))
+    with pytest.raises(PgmError):
+        read_pgm(p)
