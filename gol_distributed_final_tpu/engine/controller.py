@@ -118,7 +118,10 @@ class _Ticker:
                 self._handle_key(key)
                 continue
             if time.monotonic() >= next_tick:
-                next_tick += self.tick_seconds
+                # re-anchor rather than increment: after a long keypress
+                # handler (PGM write, compile stall) we coalesce missed
+                # ticks like Go's time.Ticker instead of bursting them
+                next_tick = time.monotonic() + self.tick_seconds
                 # count-only snapshot: a device-side reduction, no full-board
                 # device->host copy on the tick path
                 snap = self.broker.retrieve(include_world=False)
@@ -166,7 +169,7 @@ def run(
     keypresses: "queue.Queue | None" = None,
     *,
     broker=None,
-    rule=CONWAY,
+    rule=None,
     engine_config: EngineConfig | None = None,
     emit_flips: bool = False,
     images_dir="images",
@@ -185,7 +188,12 @@ def run(
     if events is None:
         events = queue.Queue()
     if engine_config is None:
-        engine_config = EngineConfig(rule=rule)
+        engine_config = EngineConfig(rule=rule if rule is not None else CONWAY)
+    elif rule is not None:
+        raise ValueError(
+            "pass the rule inside engine_config (EngineConfig(rule=...)); "
+            "the separate rule= argument would be silently ignored"
+        )
     if broker is None:
         broker = InProcessBroker(Engine(engine_config))
 

@@ -119,7 +119,10 @@ class Engine:
         """
         import jax.numpy as jnp
 
-        world = np.asarray(world, np.uint8)
+        # defensive copy: the caller may reuse its buffer, and we hand this
+        # array out via retrieve()/emit_flips diffs
+        world = np.array(world, np.uint8, copy=True)
+        world.flags.writeable = False
         with self._lock:
             if self._running:
                 raise RuntimeError("engine is already running")

@@ -1,0 +1,130 @@
+"""Halo-exchange stencil steps over a device mesh — the data plane.
+
+The reference ships the FULL board to every worker every turn and gathers
+strips back over TCP: O(Threads x H x W) bytes per turn through the broker
+(broker/broker.go:135-224, the central scalability limit README.md:204 points
+at). Here each device owns one block of the board permanently; per turn it
+exchanges only its 1-cell-deep halo with mesh neighbours via
+``lax.ppermute`` over ICI — O(perimeter) bytes, no host involvement.
+
+Corner cells are handled by the classic two-phase exchange: rows first
+(blocks grow to (h+2, w)), then columns of the *extended* block (to
+(h+2, w+2)) — the column messages carry the row halos' end cells, so corner
+neighbours arrive without dedicated diagonal sends.
+
+All functions close over a Mesh with axes ('rows', 'cols'); either axis may
+have size 1 (a 1-D decomposition is just a degenerate 2-D one).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models import CONWAY, LifeRule
+from ..ops.stencil import apply_rule
+from .mesh import COLS, ROWS
+
+
+def board_sharding(mesh: Mesh) -> NamedSharding:
+    """The canonical board sharding: rows over 'rows', cols over 'cols'."""
+    return NamedSharding(mesh, P(ROWS, COLS))
+
+
+def _ring_perm(n: int, direction: int) -> list[tuple[int, int]]:
+    """Ring permutation: each device i sends to (i + direction) mod n."""
+    return [(i, (i + direction) % n) for i in range(n)]
+
+
+def _exchange(block, axis_name: str, n: int, dim: int):
+    """Prepend/append wrap-around halo slices of thickness 1 along ``dim``,
+    exchanged with ring neighbours on ``axis_name``.
+
+    With a single device on the axis the halo is local wrap — the same
+    concat, no communication.
+    """
+    if dim == 0:
+        first, last = block[:1], block[-1:]
+    else:
+        first, last = block[:, :1], block[:, -1:]
+    if n == 1:
+        before, after = last, first
+    else:
+        # my 'before' halo is the previous device's last slice: everyone
+        # sends their last slice one step forward along the ring
+        before = lax.ppermute(last, axis_name, _ring_perm(n, 1))
+        after = lax.ppermute(first, axis_name, _ring_perm(n, -1))
+    return jnp.concatenate([before, block, after], axis=dim)
+
+
+def _local_step(block, *, rule: LifeRule, mesh_shape: tuple[int, int]):
+    """One turn on a local block, halos included. Runs inside shard_map."""
+    nrows, ncols = mesh_shape
+    ext = _exchange(block, ROWS, nrows, dim=0)          # (h+2, w)
+    ext = _exchange(ext, COLS, ncols, dim=1)            # (h+2, w+2), corners ok
+    h, w = block.shape
+    ones = (ext != 0).astype(jnp.uint8)
+    counts = jnp.zeros((h, w), jnp.uint8)
+    for dy in (0, 1, 2):
+        for dx in (0, 1, 2):
+            if (dy, dx) == (1, 1):
+                continue
+            counts = counts + ones[dy : dy + h, dx : dx + w]
+    return apply_rule(
+        block, counts, birth_mask=rule.birth_mask, survive_mask=rule.survive_mask
+    )
+
+
+def sharded_step_fn(mesh: Mesh, rule: LifeRule = CONWAY) -> Callable:
+    """A jitted ``board -> board`` over a globally-sharded ``uint8[H, W]``.
+
+    The input is (re)placed to the canonical sharding by jit; the output
+    keeps it, so a turn loop never reshards.
+    """
+    mesh_shape = (mesh.shape[ROWS], mesh.shape[COLS])
+    local = functools.partial(_local_step, rule=rule, mesh_shape=mesh_shape)
+    sharded = jax.shard_map(
+        local, mesh=mesh, in_specs=P(ROWS, COLS), out_specs=P(ROWS, COLS)
+    )
+    sharding = board_sharding(mesh)
+    return jax.jit(sharded, in_shardings=sharding, out_shardings=sharding)
+
+
+def sharded_step_n_fn(mesh: Mesh, rule: LifeRule = CONWAY) -> Callable:
+    """A jitted ``(board, n) -> board`` running ``n`` turns in ONE dispatch.
+
+    The ``lax.fori_loop`` lives *inside* shard_map, so the whole multi-turn
+    evolution — halo ppermutes included — compiles to a single XLA program
+    per device: the per-turn synchronisation the reference implements as a
+    host-side gather barrier (broker/broker.go:154-156) is just the
+    dataflow dependency between collective and stencil.
+    """
+    mesh_shape = (mesh.shape[ROWS], mesh.shape[COLS])
+    local = functools.partial(_local_step, rule=rule, mesh_shape=mesh_shape)
+    sharding = board_sharding(mesh)
+
+    @functools.lru_cache(maxsize=None)
+    def _compiled(n: int):
+        def local_n(block):
+            return lax.fori_loop(0, n, lambda _, b: local(b), block)
+
+        sharded = jax.shard_map(
+            local_n, mesh=mesh, in_specs=P(ROWS, COLS), out_specs=P(ROWS, COLS)
+        )
+        return jax.jit(sharded, in_shardings=sharding, out_shardings=sharding)
+
+    def step_n(board, n):
+        return _compiled(int(n))(board)
+
+    return step_n
+
+
+def make_engine_step(mesh: Mesh, rule: LifeRule = CONWAY) -> Callable:
+    """An ``EngineConfig.step_n_fn``-compatible callable: the engine's turn
+    loop runs the whole mesh as one SPMD program."""
+    return sharded_step_n_fn(mesh, rule)

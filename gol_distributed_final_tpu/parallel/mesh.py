@@ -1,0 +1,73 @@
+"""Device-mesh construction.
+
+The reference's "cluster topology" is a hardcoded list of 8 worker addresses
+(broker/broker.go:288-300). Here topology is a ``jax.sharding.Mesh``: rows
+(and, for 2-D, columns) of the board are sharded over mesh axes, and all
+data-plane communication is XLA collectives over ICI — no address list, no
+dial loop, no per-turn TCP.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+from jax.sharding import Mesh
+
+ROWS, COLS = "rows", "cols"
+
+
+def best_mesh_shape(n_devices: int, height: int, width: int) -> tuple[int, int]:
+    """Pick a (rows, cols) mesh factorisation of ``n_devices``.
+
+    Prefers the most square factorisation that divides the board evenly —
+    a 2-D decomposition halves the per-device halo perimeter vs 1-D at the
+    same device count (SURVEY.md §2 'TPU-native equivalent').
+    Falls back toward 1-D if the board doesn't divide.
+    """
+    best = (n_devices, 1)
+    best_score = None
+    for r in range(1, n_devices + 1):
+        if n_devices % r:
+            continue
+        c = n_devices // r
+        if height % r or width % c:
+            continue
+        # minimise halo perimeter per device: w/c + h/r (two row edges of
+        # length w/c, two col edges of length h/r)
+        score = width // c + height // r
+        if best_score is None or score < best_score:
+            best, best_score = (r, c), score
+    if best_score is None:
+        raise ValueError(
+            f"no (rows, cols) factorisation of {n_devices} devices divides "
+            f"a {height}x{width} board evenly"
+        )
+    return best
+
+
+def make_mesh(
+    shape: tuple[int, int] | None = None,
+    devices=None,
+    *,
+    height: int | None = None,
+    width: int | None = None,
+) -> Mesh:
+    """Build a ('rows', 'cols') mesh over ``devices`` (default: all).
+
+    If ``shape`` is omitted, chooses via ``best_mesh_shape`` (requires
+    height/width).
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if shape is None:
+        if height is None or width is None:
+            raise ValueError("either shape or (height, width) is required")
+        shape = best_mesh_shape(n, height, width)
+    r, c = shape
+    if r * c != n:
+        raise ValueError(f"mesh shape {shape} does not use all {n} devices")
+    import numpy as np
+
+    return Mesh(np.asarray(devices).reshape(r, c), (ROWS, COLS))
