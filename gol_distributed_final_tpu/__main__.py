@@ -1,0 +1,94 @@
+"""Controller process entry point — the main.go equivalent.
+
+Flags mirror the reference (main.go:17-46): -t threads, -w width, -h height,
+-turns, -noVis, plus -server (gol/distributor.go:12) to drive a remote broker
+instead of the in-process engine. ``-h`` is board height as in the
+reference, so argparse's auto-help is disabled; use --help.
+
+Headless mode drains the event stream and prints every event with a
+non-empty string as ``Completed Turns <n> <event>`` (sdl/loop.go:44-47;
+main.go:59-67's -noVis drain). With a TTY, keypresses s/q/k/p are read raw
+from stdin and forwarded like the SDL keymap (sdl/loop.go:16-28).
+"""
+
+from __future__ import annotations
+
+import argparse
+import queue
+import sys
+import threading
+
+
+def _stdin_keys(keypresses: "queue.Queue", done: threading.Event) -> None:
+    """Forward raw single-key presses (s/q/k/p) from a TTY."""
+    import termios
+    import tty
+
+    fd = sys.stdin.fileno()
+    old = termios.tcgetattr(fd)
+    try:
+        tty.setcbreak(fd)
+        while not done.is_set():
+            ch = sys.stdin.read(1)
+            if ch in ("s", "q", "k", "p"):
+                keypresses.put(ch)
+    finally:
+        termios.tcsetattr(fd, termios.TCSADRAIN, old)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="gol_distributed_final_tpu", add_help=False
+    )
+    parser.add_argument("--help", action="help")
+    parser.add_argument("-t", type=int, default=8, help="threads / worker shards")
+    parser.add_argument("-w", type=int, default=512, help="board width")
+    parser.add_argument("-h", type=int, default=512, help="board height")
+    parser.add_argument("-turns", type=int, default=10000000000)
+    parser.add_argument("-noVis", action="store_true", default=False)
+    parser.add_argument(
+        "-server", default="", help="broker address (empty: in-process engine)"
+    )
+    args = parser.parse_args(argv)
+
+    from . import Params, run
+    from .engine.controller import iter_events
+
+    params = Params(
+        turns=args.turns, threads=args.t, image_width=args.w, image_height=args.h
+    )
+
+    broker = None
+    if args.server:
+        from .rpc.client import RemoteBroker
+
+        print("Server: ", args.server)
+        broker = RemoteBroker(args.server)
+
+    events: "queue.Queue" = queue.Queue()
+    keypresses: "queue.Queue" = queue.Queue()
+    done = threading.Event()
+
+    if sys.stdin.isatty():
+        threading.Thread(
+            target=_stdin_keys, args=(keypresses, done), daemon=True
+        ).start()
+
+    def consume():
+        for ev in iter_events(events):
+            text = str(ev)
+            if text:
+                print(f"Completed Turns {ev.get_completed_turns()} {text}")
+
+    consumer = threading.Thread(target=consume)
+    consumer.start()
+    try:
+        run(params, events, keypresses, broker=broker)
+    finally:
+        done.set()
+        consumer.join()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -83,12 +83,14 @@ class Engine:
         self._quit = False
         self._super_quit = False
         self._running = False
+        self._active_step_fn = None  # per-run override, set by run()
 
     # -- compute ----------------------------------------------------------
 
     def _step_n(self, board, n: int):
-        if self.config.step_n_fn is not None:
-            return self.config.step_n_fn(board, n)
+        fn = self._active_step_fn or self.config.step_n_fn
+        if fn is not None:
+            return fn(board, n)
         return self.config.rule.step_n(board, n)
 
     def _sync_host(self):
@@ -106,6 +108,7 @@ class Engine:
         *,
         emit: Optional[Callable] = None,
         emit_flips: bool = False,
+        step_n_fn: Optional[Callable] = None,
     ) -> RunResult:
         """Blocking: evolve ``world`` for ``params.turns`` turns (or until
         quit). Resets the turn counter — a reattaching controller starts a
@@ -127,6 +130,10 @@ class Engine:
             if self._running:
                 raise RuntimeError("engine is already running")
             self._running = True
+            # per-run step override (e.g. a geometry-specific mesh step):
+            # set only after the already-running check, so a rejected
+            # concurrent run can't clobber the active run's step function
+            self._active_step_fn = step_n_fn
             self._board_dev = jnp.asarray(world)
             self._world_host = world
             self._host_dirty = False
@@ -195,6 +202,7 @@ class Engine:
                 self._running = False
                 self._paused = False
                 self._quit = False  # consumed; a reattached run starts fresh
+                self._active_step_fn = None
                 self._control.notify_all()
 
     # -- control plane (broker/broker.go:236-277) -------------------------

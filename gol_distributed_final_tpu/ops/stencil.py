@@ -51,6 +51,25 @@ def neighbour_counts(board: jax.Array) -> jax.Array:
     return total
 
 
+def counts_from_extended(ext: jax.Array, h: int, w: int) -> jax.Array:
+    """8-neighbour counts for the (h, w) centre of an extended array that
+    already carries a 1-cell border (halo rows/cols or local wrap).
+
+    Shared by every data plane that materialises halos explicitly: the
+    shard_map mesh step (parallel/halo.py) and the worker strip kernel
+    (rpc/worker.py) — one definition, so rule/encoding changes can't make
+    the planes diverge.
+    """
+    ones = (ext != 0).astype(jnp.uint8)
+    counts = jnp.zeros((h, w), jnp.uint8)
+    for dy in (0, 1, 2):
+        for dx in (0, 1, 2):
+            if (dy, dx) == (1, 1):
+                continue
+            counts = counts + ones[dy : dy + h, dx : dx + w]
+    return counts
+
+
 def apply_rule(
     board: jax.Array,
     counts: jax.Array,

@@ -27,7 +27,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models import CONWAY, LifeRule
-from ..ops.stencil import apply_rule
+from ..ops.stencil import apply_rule, counts_from_extended
 from .mesh import COLS, ROWS
 
 
@@ -68,13 +68,7 @@ def _local_step(block, *, rule: LifeRule, mesh_shape: tuple[int, int]):
     ext = _exchange(block, ROWS, nrows, dim=0)          # (h+2, w)
     ext = _exchange(ext, COLS, ncols, dim=1)            # (h+2, w+2), corners ok
     h, w = block.shape
-    ones = (ext != 0).astype(jnp.uint8)
-    counts = jnp.zeros((h, w), jnp.uint8)
-    for dy in (0, 1, 2):
-        for dx in (0, 1, 2):
-            if (dy, dx) == (1, 1):
-                continue
-            counts = counts + ones[dy : dy + h, dx : dx + w]
+    counts = counts_from_extended(ext, h, w)
     return apply_rule(
         block, counts, birth_mask=rule.birth_mask, survive_mask=rule.survive_mask
     )

@@ -1,0 +1,5 @@
+from .protocol import Methods, Request, Response
+from .client import RemoteBroker, RpcClient
+from .server import RpcServer
+
+__all__ = ["Methods", "Request", "Response", "RpcClient", "RpcServer", "RemoteBroker"]

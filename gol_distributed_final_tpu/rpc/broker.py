@@ -1,0 +1,318 @@
+"""The broker process — the Operations service (broker/broker.go).
+
+Two interchangeable data-plane backends behind the same RPC verbs:
+
+* ``tpu`` (default): the board lives on-device in an in-process Engine; the
+  per-turn scatter/gather of the reference collapses into chunked jitted
+  dispatches (BASELINE.json north star: "route to a single TPU worker that
+  holds the full board ... under jit"). With >1 local device the engine step
+  is the shard_map halo data plane.
+* ``workers``: reference-shaped distribution — row strips scattered to
+  remote worker processes over RPC and gathered per turn
+  (broker/broker.go:135-224), preserved for contract parity. Strips are
+  sent with 2 halo rows (O(strip) wire cost) instead of the full board.
+
+Control semantics preserved: Run blocks and resets state; Pause toggles;
+Quit breaks the loop but keeps the process alive for a reattaching
+controller; SuperQuit quits workers, then the broker itself
+(broker/broker.go:236-277, 312-323).
+"""
+
+from __future__ import annotations
+
+import argparse
+import threading
+
+import numpy as np
+
+from ..engine.engine import Engine, EngineConfig, RunResult, Snapshot
+from ..ops import alive_cells
+from .client import RpcClient, RpcError
+from .protocol import Methods, Request, Response
+from .server import RpcServer
+
+
+class TpuBackend:
+    """Engine-backed data plane (single device, or an auto mesh).
+
+    One persistent Engine serves every Run — so control verbs (Quit, Pause)
+    that land before Run has initialised are buffered by the engine's own
+    pending-control semantics instead of being dropped."""
+
+    def __init__(self, use_mesh: bool = True):
+        self._use_mesh = use_mesh
+        self.engine = Engine()
+        self._step_fns: dict = {}
+
+    def _step_fn_for(self, height: int, width: int):
+        # mesh step if the local devices divide the board; else single-device
+        key = (height, width)
+        if key not in self._step_fns:
+            fn = None
+            if self._use_mesh:
+                import jax
+
+                from ..parallel import make_engine_step, make_mesh
+
+                if len(jax.devices()) > 1:
+                    try:
+                        mesh = make_mesh(height=height, width=width)
+                        fn = make_engine_step(mesh)
+                    except ValueError:
+                        pass  # indivisible board: single-device engine
+            self._step_fns[key] = fn
+        return self._step_fns[key]
+
+    def run(self, req: Request) -> RunResult:
+        from ..params import Params
+
+        params = Params(
+            turns=req.turns,
+            threads=req.threads,
+            image_width=req.image_width,
+            image_height=req.image_height,
+        )
+        step_fn = self._step_fn_for(req.image_height, req.image_width)
+        return self.engine.run(params, req.world, step_n_fn=step_fn)
+
+    def pause(self):
+        self.engine.pause()
+
+    def quit(self):
+        self.engine.quit()
+
+    def super_quit(self):
+        self.engine.super_quit()
+
+    def retrieve(self, include_world: bool) -> Snapshot:
+        return self.engine.retrieve(include_world=include_world)
+
+
+class WorkersBackend:
+    """Reference-shaped scatter/gather over remote workers
+    (broker/broker.go:62-234), with haloed strips on the wire."""
+
+    def __init__(self, worker_addresses: list[str]):
+        self.clients: list[RpcClient] = []
+        for addr in worker_addresses:
+            try:
+                self.clients.append(RpcClient(addr, timeout=3.0))
+            except OSError:
+                # skip dead addresses, proceed with the connected subset
+                # (isConnected, broker/broker.go:39-45, 302-311)
+                print(f"worker {addr} unreachable, skipping")
+        print(f"{len(self.clients)} workers connected")
+        self._lock = threading.Lock()
+        self._control = threading.Condition(self._lock)
+        self._world: np.ndarray | None = None
+        self._turn = 0
+        self._paused = False
+        self._quit = False
+        self._running = False
+
+    def run(self, req: Request) -> RunResult:
+        if not self.clients:
+            raise RpcError("no workers connected")
+        world = np.array(req.world, np.uint8, copy=True)
+        h = world.shape[0]
+        n = max(1, min(req.threads or len(self.clients), len(self.clients), h))
+        with self._lock:
+            if self._running:
+                raise RpcError("a run is already in progress")
+            self._world, self._turn = world, 0
+            self._paused = False
+            self._running = True
+
+        # row split: even shares, remainder to the first h % n workers
+        # (broker/broker.go:135-224)
+        base, rem = divmod(h, n)
+        bounds = []
+        y = 0
+        for i in range(n):
+            size = base + (1 if i < rem else 0)
+            bounds.append((y, y + size))
+            y += size
+
+        try:
+            self._turn_loop(req, bounds, n, h)
+        finally:
+            with self._lock:
+                self._running = False
+                self._quit = False  # consumed: a reattached Run starts fresh
+                self._control.notify_all()
+        with self._lock:
+            return RunResult(self._turn, self._world, alive_cells(self._world))
+
+    def _turn_loop(self, req: Request, bounds, n: int, h: int) -> None:
+        for _ in range(req.turns):
+            with self._lock:
+                while self._paused and not self._quit:
+                    self._control.wait()
+                if self._quit:
+                    break
+                world = self._world
+
+            strips: list = [None] * n
+            errors: list = []
+
+            def scatter(i: int, client: RpcClient):
+                s, e = bounds[i]
+                rows = np.arange(s - 1, e + 1) % h
+                try:
+                    res = client.call(
+                        Methods.WORKER_UPDATE,
+                        Request(world=world[rows], start_y=-1, worker=i),
+                    )
+                    strips[i] = res.work_slice
+                except RpcError as e:
+                    errors.append(e)
+
+            threads = [
+                threading.Thread(target=scatter, args=(i, self.clients[i]))
+                for i in range(n)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if errors:
+                with self._lock:
+                    if self._quit:
+                        break  # shutdown race: a quitting worker dropped a call
+                raise RpcError(f"worker failed mid-run: {errors[0]}")
+
+            new_world = np.concatenate(strips, axis=0)
+            with self._lock:
+                self._world = new_world
+                self._turn += 1
+
+    def pause(self):
+        with self._lock:
+            self._paused = not self._paused
+            self._control.notify_all()
+            print("State paused" if self._paused else "State unpaused")
+
+    def quit(self):
+        with self._lock:
+            self._quit = True
+            self._control.notify_all()
+
+    def super_quit(self):
+        self.quit()
+        # let the run loop (and its in-flight scatter) finish before taking
+        # the workers down (broker/broker.go:241-249 quits loop, then workers)
+        with self._lock:
+            self._control.wait_for(lambda: not self._running, timeout=30)
+        for client in self.clients:
+            try:
+                client.call(Methods.WORKER_QUIT, Request())
+            except RpcError:
+                pass
+
+    def retrieve(self, include_world: bool) -> Snapshot:
+        with self._lock:
+            world = self._world
+            turn = self._turn
+        if world is None:
+            return Snapshot(np.zeros((0, 0), np.uint8), 0, 0)
+        return Snapshot(
+            world if include_world else None, turn, int(np.count_nonzero(world))
+        )
+
+
+class BrokerService:
+    """Maps the wire verbs onto a backend; owns process shutdown."""
+
+    def __init__(self, server: RpcServer, backend):
+        self._server = server
+        self.backend = backend
+        self.quit_event = threading.Event()
+
+    def run(self, req: Request) -> Response:
+        result = self.backend.run(req)
+        return Response(
+            alive=result.alive,
+            alive_count=len(result.alive),
+            turns_completed=result.turns_completed,
+            world=result.world,
+        )
+
+    def pause(self, req: Request) -> Response:
+        self.backend.pause()
+        return Response()
+
+    def quit(self, req: Request) -> Response:
+        self.backend.quit()
+        return Response()
+
+    def super_quit(self, req: Request) -> Response:
+        self.backend.super_quit()
+        # reply first and let any in-flight Run return its result, THEN
+        # close the listener (broker/broker.go:312-323's goroutine)
+        threading.Thread(target=self._shutdown_when_idle, daemon=True).start()
+        return Response()
+
+    def _shutdown_when_idle(self):
+        # waits until every dispatch — including the in-flight Run and the
+        # SuperQuit call itself — has fully SENT its reply frame
+        self._server.wait_idle(timeout=60)
+        self._shutdown()
+
+    def retrieve(self, req: Request) -> Response:
+        snap = self.backend.retrieve(req.include_world)
+        # alive stays empty on the wire: the client derives cells from the
+        # world locally, and pickling ~10^5 Cell objects per snapshot is
+        # pure waste (the reference DOES ship them, broker/broker.go:272)
+        return Response(
+            alive_count=snap.alive_count,
+            turns_completed=snap.turns_completed,
+            world=snap.world,
+            alive=[],
+        )
+
+    def _shutdown(self):
+        self._server.stop()
+        self.quit_event.set()
+
+
+def serve(
+    port: int = 8040,
+    backend: str = "tpu",
+    worker_addresses: list[str] | None = None,
+) -> tuple[RpcServer, BrokerService]:
+    server = RpcServer(port=port)
+    impl = (
+        WorkersBackend(worker_addresses or [])
+        if backend == "workers"
+        else TpuBackend()
+    )
+    service = BrokerService(server, impl)
+    server.register(Methods.BROKER_RUN, service.run)
+    server.register(Methods.PAUSE, service.pause)
+    server.register(Methods.QUIT, service.quit)
+    server.register(Methods.SUPER_QUIT, service.super_quit)
+    server.register(Methods.RETRIEVE, service.retrieve)
+    server.serve_background()
+    return server, service
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description="GoL broker / engine server")
+    parser.add_argument("-port", type=int, default=8040)
+    parser.add_argument(
+        "-backend", choices=("tpu", "workers"), default="tpu",
+        help="tpu: on-device engine (default); workers: scatter to -workers",
+    )
+    parser.add_argument(
+        "-workers", default="",
+        help="comma-separated worker addresses for -backend workers",
+    )
+    args = parser.parse_args(argv)
+    addresses = [a for a in args.workers.split(",") if a]
+    server, service = serve(args.port, args.backend, addresses)
+    print(f"broker listening on :{server.port} (backend={args.backend})", flush=True)
+    service.quit_event.wait()
+
+
+if __name__ == "__main__":
+    main()

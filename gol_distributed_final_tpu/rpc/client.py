@@ -1,0 +1,130 @@
+"""RPC client with concurrent in-flight calls (the rpc.Client role).
+
+The controller needs this concurrency: its main thread blocks in
+``Operations.Run`` for the entire game while the ticker thread issues
+``RetrieveCurrentData``/``Pause`` on the same connection
+(gol/distributor.go:159 + :45). Calls are multiplexed by id; a reader
+thread routes replies to per-call events.
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import threading
+
+from .protocol import Methods, Request, recv_frame, send_frame
+
+
+class RpcError(Exception):
+    """A server-side error surfaced to the caller (net/rpc's error return)."""
+
+
+class RpcClient:
+    def __init__(self, address: str, timeout: float | None = None):
+        host, port = address.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)), timeout=timeout)
+        self._sock.settimeout(None)
+        self._write_lock = threading.Lock()
+        self._ids = itertools.count()
+        self._pending: dict[int, dict] = {}
+        self._pending_lock = threading.Lock()
+        self._closed = threading.Event()
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                msg = recv_frame(self._sock)
+                with self._pending_lock:
+                    slot = self._pending.pop(msg["id"], None)
+                if slot is not None:
+                    slot["reply"] = msg
+                    slot["event"].set()
+        except (ConnectionError, OSError):
+            self._closed.set()
+            with self._pending_lock:
+                for slot in self._pending.values():
+                    slot["event"].set()
+                self._pending.clear()
+
+    def call(self, method: str, request: Request):
+        """Blocking call, safe from any thread."""
+        if self._closed.is_set():
+            raise RpcError("connection closed")
+        call_id = next(self._ids)
+        slot = {"event": threading.Event(), "reply": None}
+        with self._pending_lock:
+            self._pending[call_id] = slot
+        # re-check after registering: if the reader died in between, it has
+        # already drained _pending and our slot's event would never be set
+        if self._closed.is_set():
+            with self._pending_lock:
+                self._pending.pop(call_id, None)
+            raise RpcError("connection closed")
+        try:
+            with self._write_lock:
+                send_frame(
+                    self._sock,
+                    {"id": call_id, "method": method, "request": request},
+                )
+        except OSError as e:
+            with self._pending_lock:
+                self._pending.pop(call_id, None)
+            raise RpcError(f"send failed: {e}") from e
+        slot["event"].wait()
+        reply = slot["reply"]
+        if reply is None:
+            raise RpcError("connection closed before reply")
+        if "error" in reply:
+            raise RpcError(reply["error"])
+        return reply["result"]
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class RemoteBroker:
+    """The controller-side broker handle: same surface as InProcessBroker,
+    served over RPC (the rpc.Dial("tcp", *server) role, gol/distributor.go:136)."""
+
+    def __init__(self, address: str = "127.0.0.1:8040", timeout: float | None = 10.0):
+        self.client = RpcClient(address, timeout=timeout)
+
+    def run(self, params, world, *, emit=None, emit_flips=False):
+        # emit/emit_flips are single-host features; the distributed reference
+        # never emits CellFlipped/TurnComplete either (SURVEY.md §4 TestSdl note)
+        req = Request(
+            world=world,
+            turns=params.turns,
+            image_height=params.image_height,
+            image_width=params.image_width,
+            threads=params.threads,
+        )
+        res = self.client.call(Methods.BROKER_RUN, req)
+        from ..engine.engine import RunResult
+
+        return RunResult(res.turns_completed, res.world, res.alive)
+
+    def pause(self):
+        self.client.call(Methods.PAUSE, Request())
+
+    def quit(self):
+        self.client.call(Methods.QUIT, Request())
+
+    def super_quit(self):
+        self.client.call(Methods.SUPER_QUIT, Request())
+
+    def retrieve(self, include_world: bool = True):
+        res = self.client.call(Methods.RETRIEVE, Request(include_world=include_world))
+        from ..engine.engine import Snapshot
+
+        return Snapshot(res.world, res.turns_completed, res.alive_count)
+
+    def close(self):
+        self.client.close()

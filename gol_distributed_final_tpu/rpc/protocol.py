@@ -1,0 +1,92 @@
+"""The RPC contract — shared vocabulary between controller, broker, workers.
+
+Method names and wire-struct fields mirror the reference's stubs package
+(stubs/stubs.go:5-38) so the control-plane semantics — Run blocks for the
+whole game, Retrieve snapshots, Pause toggles, Quit detaches, SuperQuit
+shuts the system down, Update computes one strip — carry over verbatim.
+
+Transport is length-prefixed pickle frames over TCP (the Go reference uses
+gob over TCP, net/rpc — same trust model: a private, same-deployment
+boundary, not an internet-facing API).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pickle
+import struct
+from typing import List, Optional
+
+import numpy as np
+
+
+class Methods:
+    """Method-name constants (stubs/stubs.go:5-11)."""
+
+    BROKER_RUN = "Operations.Run"
+    RETRIEVE = "Operations.RetrieveCurrentData"
+    PAUSE = "Operations.Pause"
+    QUIT = "Operations.Quit"
+    SUPER_QUIT = "Operations.SuperQuit"
+    WORKER_UPDATE = "GameOfLifeOperations.Update"
+    WORKER_QUIT = "GameOfLifeOperations.WorkerQuit"
+
+
+@dataclasses.dataclass
+class Request:
+    """Mirror of stubs.Request (stubs/stubs.go:20-29)."""
+
+    world: Optional[np.ndarray] = None
+    turns: int = 0
+    image_height: int = 0
+    image_width: int = 0
+    threads: int = 0
+    start_y: int = 0
+    end_y: int = 0
+    worker: int = 0
+    include_world: bool = True  # extension: count-only Retrieve
+
+
+@dataclasses.dataclass
+class Response:
+    """Mirror of stubs.Response (stubs/stubs.go:31-38)."""
+
+    alive: Optional[List] = None
+    alive_count: int = 0
+    turns_completed: int = 0
+    world: Optional[np.ndarray] = None
+    work_slice: Optional[np.ndarray] = None
+    worker: int = 0
+
+
+# -- framing ----------------------------------------------------------------
+
+_HEADER = struct.Struct(">Q")
+MAX_FRAME = 1 << 34  # 16 GiB: a 65536^2 board is ~4 GiB
+
+
+def send_frame(sock, obj) -> None:
+    """Callers must serialise sends per-socket (both RpcClient and RpcServer
+    hold a write lock). Two sendalls avoid concatenating header+payload,
+    which would double peak memory on multi-GiB board frames."""
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_HEADER.pack(len(payload)))
+    sock.sendall(payload)
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionError("peer closed the connection")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def recv_frame(sock):
+    (length,) = _HEADER.unpack(_recv_exact(sock, _HEADER.size))
+    if length > MAX_FRAME:
+        raise ConnectionError(f"frame of {length} bytes exceeds limit")
+    return pickle.loads(_recv_exact(sock, length))

@@ -1,0 +1,103 @@
+"""Minimal concurrent RPC server (the net/rpc role, broker/broker.go:284-285).
+
+One thread per connection, one thread per in-flight request — so a blocking
+``Operations.Run`` on a connection never blocks ``Pause``/``Retrieve``
+arriving on the same or other connections, matching Go net/rpc's
+goroutine-per-call model.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+from .protocol import recv_frame, send_frame
+
+
+class RpcServer:
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self.port = self._sock.getsockname()[1]
+        self._methods: dict[str, callable] = {}
+        self._stopped = threading.Event()
+        self._accept_thread: threading.Thread | None = None
+        self._inflight = 0
+        self._inflight_cv = threading.Condition()
+
+    def register(self, name: str, fn) -> None:
+        """Register a handler: fn(request_dataclass) -> response object."""
+        self._methods[name] = fn
+
+    def serve_background(self) -> None:
+        self._accept_thread = threading.Thread(target=self.serve, daemon=True)
+        self._accept_thread.start()
+
+    def serve(self) -> None:
+        while not self._stopped.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                break  # listener closed by stop()
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        write_lock = threading.Lock()
+        try:
+            while True:
+                try:
+                    msg = recv_frame(conn)
+                except (ConnectionError, OSError):
+                    return
+                threading.Thread(
+                    target=self._dispatch,
+                    args=(conn, write_lock, msg),
+                    daemon=True,
+                ).start()
+        finally:
+            conn.close()
+
+    def _dispatch(self, conn, write_lock, msg) -> None:
+        with self._inflight_cv:
+            self._inflight += 1
+        try:
+            call_id, method, request = msg["id"], msg["method"], msg["request"]
+            try:
+                fn = self._methods[method]
+            except KeyError:
+                reply = {"id": call_id, "error": f"unknown method: {method}"}
+            else:
+                try:
+                    reply = {"id": call_id, "result": fn(request)}
+                except Exception as e:  # error crosses the wire, like net/rpc
+                    reply = {"id": call_id, "error": f"{type(e).__name__}: {e}"}
+            try:
+                with write_lock:
+                    send_frame(conn, reply)
+            except OSError:
+                pass  # peer went away; nothing to tell it
+        finally:
+            # the reply frame is on the wire: only now does the call stop
+            # counting as in-flight (wait_idle gates process shutdown on this)
+            with self._inflight_cv:
+                self._inflight -= 1
+                self._inflight_cv.notify_all()
+
+    def wait_idle(self, timeout: float | None = None) -> bool:
+        """Block until no dispatch is in flight (replies fully sent)."""
+        with self._inflight_cv:
+            return self._inflight_cv.wait_for(
+                lambda: self._inflight == 0, timeout=timeout
+            )
+
+    def stop(self) -> None:
+        """Close the listener (broker/broker.go:322, listener.Close)."""
+        self._stopped.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass

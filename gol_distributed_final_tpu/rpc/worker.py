@@ -1,0 +1,110 @@
+"""The worker process — GameOfLifeOperations service (worker/worker.go:72-112).
+
+Serves ``Update`` (compute one row strip of the next board state) and
+``WorkerQuit``. The strip kernel is the jitted XLA stencil: the broker sends
+the strip plus its two wrap-around halo rows, and the worker returns the
+evolved strip — unlike the reference, which ships the ENTIRE board to every
+worker and lets each one index its strip (worker/worker.go:78,
+broker/broker.go:144). The wire cost drops from O(H x W) to
+O(strip + 2 rows) per call while preserving the verbs.
+
+For reference-exact wire behavior the worker also accepts full-board
+requests (halo rows derived locally) — the broker chooses per its
+``wire`` mode.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import threading
+
+import numpy as np
+
+from .protocol import Methods, Request, Response
+from .server import RpcServer
+
+
+@functools.lru_cache(maxsize=None)
+def _strip_step():
+    """(h+2, w) padded strip -> (h, w) next strip, columns wrapping locally."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import CONWAY
+    from ..ops.stencil import apply_rule, counts_from_extended
+
+    @jax.jit
+    def step(padded):
+        ext = jnp.concatenate([padded[:, -1:], padded, padded[:, :1]], axis=1)
+        h = padded.shape[0] - 2
+        w = padded.shape[1]
+        counts = counts_from_extended(ext, h, w)
+        return apply_rule(
+            padded[1:-1],
+            counts,
+            birth_mask=CONWAY.birth_mask,
+            survive_mask=CONWAY.survive_mask,
+        )
+
+    return step
+
+
+def compute_strip(world: np.ndarray, start_y: int, end_y: int) -> np.ndarray:
+    """Next state of rows [start_y, end_y) given the full board —
+    the calculateNextState contract (worker/worker.go:15)."""
+    h = world.shape[0]
+    rows = np.arange(start_y - 1, end_y + 1) % h
+    padded = world[rows]
+    return np.asarray(_strip_step()(padded))
+
+
+def compute_strip_haloed(padded: np.ndarray) -> np.ndarray:
+    """Next state of a strip sent WITH its halo rows (rows 0 and -1)."""
+    return np.asarray(_strip_step()(padded))
+
+
+class WorkerService:
+    def __init__(self, server: RpcServer):
+        self._server = server
+        self.quit_event = threading.Event()
+
+    def update(self, req: Request) -> Response:
+        world = np.asarray(req.world, np.uint8)
+        if req.start_y == -1:  # haloed-strip wire mode
+            return Response(work_slice=compute_strip_haloed(world), worker=req.worker)
+        return Response(
+            work_slice=compute_strip(world, req.start_y, req.end_y),
+            worker=req.worker,
+        )
+
+    def worker_quit(self, req: Request) -> Response:
+        # reply first, then shut the listener (worker/worker.go:82-86)
+        threading.Timer(0.05, self._shutdown).start()
+        return Response()
+
+    def _shutdown(self):
+        self._server.stop()
+        self.quit_event.set()
+
+
+def serve(port: int = 8030) -> tuple[RpcServer, WorkerService]:
+    server = RpcServer(port=port)
+    service = WorkerService(server)
+    server.register(Methods.WORKER_UPDATE, service.update)
+    server.register(Methods.WORKER_QUIT, service.worker_quit)
+    server.serve_background()
+    return server, service
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(description="GoL worker node")
+    parser.add_argument("-port", type=int, default=8030)
+    args = parser.parse_args(argv)
+    server, service = serve(args.port)
+    print(f"worker listening on :{server.port}", flush=True)
+    service.quit_event.wait()
+
+
+if __name__ == "__main__":
+    main()

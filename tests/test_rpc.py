@@ -1,0 +1,191 @@
+"""Distributed control-plane tests: real broker + worker subprocesses over
+TCP, driven through the public controller with a RemoteBroker — the full
+three-process topology of the reference (controller / broker / workers),
+golden-exact.
+"""
+
+import os
+import queue
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gol_distributed_final_tpu import FinalTurnComplete, Params, run
+from gol_distributed_final_tpu.engine.controller import CLOSED, iter_events
+from gol_distributed_final_tpu.rpc.client import RemoteBroker, RpcClient, RpcError
+from gol_distributed_final_tpu.rpc.protocol import Methods, Request
+
+from helpers import REPO_ROOT, assert_equal_board, read_alive_cells
+
+
+def _spawn(module: str, *args: str) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", module, *args],
+        cwd=REPO_ROOT,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    return proc
+
+
+def _wait_listening(proc: subprocess.Popen, timeout=60) -> int:
+    """Parse 'listening on :<port>' from process stdout."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if "listening on :" in line:
+            return int(line.rsplit(":", 1)[1].split()[0])
+        if proc.poll() is not None:
+            raise RuntimeError(f"process died: {proc.stdout.read()}")
+    raise TimeoutError("server did not report listening")
+
+
+@pytest.fixture(scope="module")
+def worker_cluster():
+    """Two workers + a workers-backend broker, torn down via SuperQuit."""
+    workers = [_spawn("gol_distributed_final_tpu.rpc.worker", "-port", "0") for _ in range(2)]
+    ports = [_wait_listening(w) for w in workers]
+    addrs = ",".join(f"127.0.0.1:{p}" for p in ports)
+    broker = _spawn(
+        "gol_distributed_final_tpu.rpc.broker",
+        "-port", "0", "-backend", "workers", "-workers", addrs,
+    )
+    broker_port = _wait_listening(broker)
+    yield f"127.0.0.1:{broker_port}", workers, broker
+    for p in (*workers, broker):
+        if p.poll() is None:
+            p.kill()
+        p.wait()
+
+
+@pytest.fixture(scope="module")
+def tpu_broker():
+    """A tpu-backend broker subprocess (single virtual CPU device)."""
+    broker = _spawn("gol_distributed_final_tpu.rpc.broker", "-port", "0")
+    port = _wait_listening(broker)
+    yield f"127.0.0.1:{port}", broker
+    if broker.poll() is None:
+        broker.kill()
+    broker.wait()
+
+
+def _run_remote(address, size, turns, tmp_path, keys=None, tick=3600.0):
+    p = Params(turns=turns, threads=8, image_width=size, image_height=size)
+    events = queue.Queue()
+    remote = RemoteBroker(address)
+    try:
+        result = run(
+            p,
+            events,
+            keys,
+            broker=remote,
+            images_dir=REPO_ROOT / "images",
+            out_dir=tmp_path / "out",
+            tick_seconds=tick,
+        )
+    finally:
+        remote.close()
+    drained = []
+    while True:
+        ev = events.get_nowait()
+        if ev is CLOSED:
+            break
+        drained.append(ev)
+    return result, drained
+
+
+@pytest.mark.parametrize("size,turns", [(16, 100), (64, 100)])
+def test_workers_backend_golden(worker_cluster, size, turns, tmp_path):
+    address, _, _ = worker_cluster
+    result, events = _run_remote(address, size, turns, tmp_path)
+    finals = [e for e in events if isinstance(e, FinalTurnComplete)]
+    expected = read_alive_cells(
+        REPO_ROOT / "check" / "images" / f"{size}x{size}x{turns}.pgm"
+    )
+    assert_equal_board(finals[0].alive, expected, size, size)
+
+
+def test_tpu_backend_golden(tpu_broker, tmp_path):
+    address, _ = tpu_broker
+    result, events = _run_remote(address, 64, 100, tmp_path)
+    expected = read_alive_cells(REPO_ROOT / "check" / "images" / "64x64x100.pgm")
+    assert_equal_board(result.alive, expected, 64, 64)
+
+
+def test_detach_reattach(tpu_broker, tmp_path):
+    """'q' detaches the controller; the broker survives and a fresh
+    controller session completes on the same broker (README.md:187)."""
+    address, broker_proc = tpu_broker
+    keys = queue.Queue()
+    keys.put("q")  # quit immediately once the ticker sees it
+    result, _ = _run_remote(address, 16, 100_000_000, tmp_path, keys=keys, tick=0.1)
+    assert result.turns_completed < 100_000_000
+    assert broker_proc.poll() is None  # broker still alive
+
+    # reattach: a complete fresh run against the same broker
+    result2, _ = _run_remote(address, 16, 100, tmp_path)
+    expected = read_alive_cells(REPO_ROOT / "check" / "images" / "16x16x100.pgm")
+    assert_equal_board(result2.alive, expected, 16, 16)
+
+
+def test_remote_pause_retrieve(tpu_broker, tmp_path):
+    """Pause over RPC freezes the turn counter; retrieve is live during Run."""
+    address, _ = tpu_broker
+    remote = RemoteBroker(address)
+    p = Params(turns=100_000_000, threads=1, image_width=64, image_height=64)
+    import gol_distributed_final_tpu.io.pgm as pgm
+
+    board = pgm.read_board(p, REPO_ROOT / "images")
+    t = threading.Thread(target=lambda: remote.run(p, board))
+    t.start()
+    try:
+        time.sleep(1.0)
+        remote.pause()
+        a = remote.retrieve(include_world=False).turns_completed
+        time.sleep(0.5)
+        b = remote.retrieve(include_world=False).turns_completed
+        assert a == b
+        remote.pause()
+        time.sleep(0.5)
+        assert remote.retrieve(include_world=False).turns_completed >= b
+    finally:
+        remote.quit()
+        t.join(timeout=30)
+        remote.close()
+    assert not t.is_alive()
+
+
+def test_super_quit_shuts_down_cluster(worker_cluster, tmp_path):
+    """'k': broker quits workers then itself (broker/broker.go:241-249)."""
+    address, workers, broker = worker_cluster
+    keys = queue.Queue()
+    keys.put("k")
+    _run_remote(address, 16, 100_000_000, tmp_path, keys=keys, tick=0.1)
+    deadline = time.monotonic() + 20
+    procs = [*workers, broker]
+    while time.monotonic() < deadline and any(p.poll() is None for p in procs):
+        time.sleep(0.2)
+    assert all(p.poll() is not None for p in procs), "cluster did not shut down"
+
+
+def test_unknown_method_and_bad_worker():
+    """Protocol robustness: unknown method errors cleanly; a dead worker
+    address is skipped at startup (isConnected, broker/broker.go:302-311)."""
+    from gol_distributed_final_tpu.rpc.server import RpcServer
+
+    server = RpcServer(port=0)
+    server.serve_background()
+    client = RpcClient(f"127.0.0.1:{server.port}")
+    with pytest.raises(RpcError, match="unknown method"):
+        client.call("Operations.Nope", Request())
+    client.close()
+    server.stop()
