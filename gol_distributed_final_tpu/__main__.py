@@ -20,20 +20,15 @@ import threading
 
 
 def _stdin_keys(keypresses: "queue.Queue", done: threading.Event) -> None:
-    """Forward raw single-key presses (s/q/k/p) from a TTY."""
-    import termios
-    import tty
+    """Forward raw single-key presses (s/q/k/p) from a TTY.
 
-    fd = sys.stdin.fileno()
-    old = termios.tcgetattr(fd)
-    try:
-        tty.setcbreak(fd)
-        while not done.is_set():
-            ch = sys.stdin.read(1)
-            if ch in ("s", "q", "k", "p"):
-                keypresses.put(ch)
-    finally:
-        termios.tcsetattr(fd, termios.TCSADRAIN, old)
+    The terminal mode is saved/restored by main(), not here: this daemon
+    thread dies blocked in read(1) at process exit, so its finally would
+    never run."""
+    while not done.is_set():
+        ch = sys.stdin.read(1)
+        if ch in ("s", "q", "k", "p"):
+            keypresses.put(ch)
 
 
 def main(argv=None) -> int:
@@ -69,7 +64,14 @@ def main(argv=None) -> int:
     keypresses: "queue.Queue" = queue.Queue()
     done = threading.Event()
 
-    if sys.stdin.isatty():
+    old_termios = None
+    if sys.stdin.isatty() and not args.noVis:
+        import termios
+        import tty
+
+        fd = sys.stdin.fileno()
+        old_termios = termios.tcgetattr(fd)
+        tty.setcbreak(fd)
         threading.Thread(
             target=_stdin_keys, args=(keypresses, done), daemon=True
         ).start()
@@ -87,6 +89,12 @@ def main(argv=None) -> int:
     finally:
         done.set()
         consumer.join()
+        if old_termios is not None:
+            import termios
+
+            termios.tcsetattr(
+                sys.stdin.fileno(), termios.TCSADRAIN, old_termios
+            )
     return 0
 
 

@@ -65,6 +65,9 @@ class EngineConfig:
     # optional override: a board -> board step (e.g. a sharded halo step from
     # parallel/halo.py, or the pallas kernel); must preserve dtype/shape
     step_n_fn: Optional[Callable] = None  # (board, n) -> board
+    # pick the fastest correct data plane automatically (ops/auto.py):
+    # on TPU the pallas VMEM bitboard kernel for Conway-compatible boards
+    auto_fast: bool = True
 
 
 class Engine:
@@ -133,6 +136,12 @@ class Engine:
             # per-run step override (e.g. a geometry-specific mesh step):
             # set only after the already-running check, so a rejected
             # concurrent run can't clobber the active run's step function
+            if step_n_fn is None and self.config.step_n_fn is None and (
+                self.config.auto_fast and not emit_flips
+            ):
+                from ..ops.auto import auto_step_n_fn
+
+                step_n_fn = auto_step_n_fn(self.config.rule, world.shape)
             self._active_step_fn = step_n_fn
             self._board_dev = jnp.asarray(world)
             self._world_host = world

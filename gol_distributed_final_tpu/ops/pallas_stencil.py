@@ -1,0 +1,217 @@
+"""Pallas TPU kernel for the Game of Life stencil — the hot-op fast path.
+
+The roll-based XLA stencil (ops/stencil.py) re-reads the board from HBM
+every turn: ~2 x H x W bytes of HBM traffic per turn plus intermediate
+materialisation. This kernel instead keeps the ENTIRE board resident in
+VMEM (a 512x512 uint8 board is 256 KiB against ~16 MiB of VMEM) and runs
+all ``n`` turns inside one kernel launch: HBM is touched exactly twice —
+one load at entry, one store at exit — regardless of ``n``. The per-turn
+work is pure VPU: 8 shifted adds on (8, 128)-lane uint8 vregs and a
+branch-free rule select.
+
+Boards larger than the VMEM budget fall back to the XLA stencil
+(``fits_vmem`` gate); the sharded mesh path gives each device a
+VMEM-sized block long before single-board VMEM becomes the limit.
+
+Reference equivalence: this computes exactly worker/worker.go:15-70's
+``calculateNextState`` over the full board, values in {0, 255}.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .stencil import apply_rule
+
+# leave generous headroom for double buffering + compiler temporaries
+VMEM_BOARD_LIMIT_BYTES = 4 * 1024 * 1024
+
+
+def fits_vmem(shape: tuple[int, int]) -> bool:
+    return shape[0] * shape[1] <= VMEM_BOARD_LIMIT_BYTES
+
+
+def _rot1(a, shift: int, axis: int, *, interpret: bool = False):
+    """Toroidal rotate by +/-1 along an axis, Mosaic-safe.
+
+    On TPU this is ``pltpu.roll`` — a native lane/sublane rotate, far
+    cheaper than the concat-of-slices ``jnp.roll`` lowers to (and
+    ``jnp.roll``'s zero-length slice for a 0 shift doesn't lower at all).
+    The interpreter path composes explicit nonempty slices instead."""
+    if shift == 0:
+        return a
+    if not interpret:
+        from jax.experimental.pallas import tpu as pltpu
+
+        # pltpu.roll requires a non-negative shift: -1 == size-1
+        return pltpu.roll(a, shift % a.shape[axis], axis)
+    if axis == 0:
+        return (
+            jnp.concatenate([a[-1:], a[:-1]], axis=0)
+            if shift > 0
+            else jnp.concatenate([a[1:], a[:1]], axis=0)
+        )
+    return (
+        jnp.concatenate([a[:, -1:], a[:, :-1]], axis=1)
+        if shift > 0
+        else jnp.concatenate([a[:, 1:], a[:, :1]], axis=1)
+    )
+
+
+def _kernel(board_ref, out_ref, *, n, birth_mask, survive_mask, interpret):
+    # Mosaic (v5e) vectors support only i16/i32 arithmetic — carry the board
+    # as int32 {0, 255} across turns, touch uint8 only at the HBM boundary
+    def rot(a, shift, axis):
+        return _rot1(a, shift, axis, interpret=interpret)
+
+    def one_turn(_, b):
+        alive = b != 0
+        ones = alive.astype(jnp.int32)
+        # separable 3x3 sum: vertical (cheap sublane shifts) then horizontal
+        # (lane shifts) — 4 rotates instead of 8, self subtracted at the end
+        vert = ones + rot(ones, 1, 0) + rot(ones, -1, 0)
+        counts = vert + rot(vert, 1, 1) + rot(vert, -1, 1) - ones
+        born = (jnp.int32(birth_mask) >> counts) & 1
+        survives = (jnp.int32(survive_mask) >> counts) & 1
+        next_alive = jnp.where(alive, survives, born) != 0
+        return jnp.where(next_alive, jnp.int32(255), jnp.int32(0))
+
+    final = lax.fori_loop(0, n, one_turn, board_ref[:].astype(jnp.int32))
+    out_ref[:] = final.astype(jnp.uint8)
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled(n: int, birth_mask: int, survive_mask: int, interpret: bool):
+    from jax.experimental import pallas as pl
+
+    kernel = functools.partial(
+        _kernel,
+        n=n,
+        birth_mask=birth_mask,
+        survive_mask=survive_mask,
+        interpret=interpret,
+    )
+
+    @jax.jit
+    def run(board):
+        if interpret:
+            return pl.pallas_call(
+                kernel,
+                out_shape=jax.ShapeDtypeStruct(board.shape, board.dtype),
+                interpret=True,
+            )(board)
+        from jax.experimental.pallas import tpu as pltpu
+
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(board.shape, board.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        )(board)
+
+    return run
+
+
+def _bit_kernel(packed_ref, out_ref, *, n, word_axis, interpret):
+    from .bitpack import bit_step
+
+    if interpret:
+        rot1 = None  # jnp.roll (bit_step never rotates by 0)
+    else:
+        # the same Mosaic-safe rotate the byte kernel uses (shift % size)
+        rot1 = functools.partial(_rot1, interpret=False)
+
+    out_ref[:] = lax.fori_loop(
+        0, n, lambda _, b: bit_step(b, word_axis, rot1), packed_ref[:]
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _bit_compiled(n: int, word_axis: int, interpret: bool):
+    from jax.experimental import pallas as pl
+
+    kernel = functools.partial(
+        _bit_kernel, n=n, word_axis=word_axis, interpret=interpret
+    )
+
+    @jax.jit
+    def run(packed):
+        kwargs = {}
+        if interpret:
+            kwargs["interpret"] = True
+        else:
+            from jax.experimental.pallas import tpu as pltpu
+
+            kwargs["in_specs"] = [pl.BlockSpec(memory_space=pltpu.VMEM)]
+            kwargs["out_specs"] = pl.BlockSpec(memory_space=pltpu.VMEM)
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(packed.shape, packed.dtype),
+            **kwargs,
+        )(packed)
+
+    return run
+
+
+def pallas_bit_step_n_fn(*, word_axis: int = 0, interpret: bool | None = None):
+    """Conway on the VMEM-resident int32 bitboard: 32 cells/word, the whole
+    n-turn evolution in ONE kernel launch — bitwise adder trees on (8,128)
+    int32 vregs, HBM touched twice total. The fastest single-device path:
+    ~0.17 us/turn on a 512x512 board on v5e (~1.6e12 cell-updates/s), ~40x
+    the roll-based XLA stencil.
+
+    ``word_axis=0`` (rows packed, array [H/32, W]) keeps the lane dimension
+    W wide — ~6x faster on TPU than word_axis=1's [H, W/32].
+
+    Engine-compatible ``(board_uint8, n) -> board_uint8``.
+    """
+    from .bitpack import bit_step_n, pack, unpack
+
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+
+    def step_n(board, n):
+        n = int(n)
+        packed = pack(board, word_axis)
+        if not fits_vmem(packed.shape):  # int32 words: limit is generous
+            out = bit_step_n(packed, n, word_axis)
+        else:
+            out = _bit_compiled(n, word_axis, interpret)(packed)
+        return jnp.asarray(unpack(out, word_axis))
+
+    return step_n
+
+
+def pallas_step_n_fn(
+    rule=None,
+    *,
+    interpret: bool | None = None,
+    fallback=None,
+):
+    """Build an ``(board, n) -> board`` running n turns in one VMEM-resident
+    kernel launch. Engine-compatible (``EngineConfig.step_n_fn``).
+
+    ``interpret`` defaults to True off-TPU (tests on the virtual CPU mesh)
+    and False on TPU. Boards too large for VMEM go to ``fallback``
+    (default: the XLA roll stencil).
+    """
+    from ..models import CONWAY
+
+    rule = rule or CONWAY
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    if fallback is None:
+        fallback = rule.step_n
+
+    def step_n(board, n):
+        n = int(n)
+        if not fits_vmem(board.shape):
+            return fallback(board, n)
+        fn = _compiled(n, rule.birth_mask, rule.survive_mask, interpret)
+        return fn(board)
+
+    return step_n

@@ -135,56 +135,56 @@ class WorkersBackend:
 
         try:
             self._turn_loop(req, bounds, n, h)
+            # capture the result BEFORE clearing _running: once the flag
+            # drops, a reattaching Run may overwrite _world/_turn
+            with self._lock:
+                result = RunResult(
+                    self._turn, self._world, alive_cells(self._world)
+                )
         finally:
             with self._lock:
                 self._running = False
                 self._quit = False  # consumed: a reattached Run starts fresh
                 self._control.notify_all()
-        with self._lock:
-            return RunResult(self._turn, self._world, alive_cells(self._world))
+        return result
 
     def _turn_loop(self, req: Request, bounds, n: int, h: int) -> None:
-        for _ in range(req.turns):
-            with self._lock:
-                while self._paused and not self._quit:
-                    self._control.wait()
-                if self._quit:
-                    break
-                world = self._world
+        import concurrent.futures
 
-            strips: list = [None] * n
-            errors: list = []
+        def scatter(args):
+            i, world = args
+            s, e = bounds[i]
+            rows = np.arange(s - 1, e + 1) % h
+            res = self.clients[i].call(
+                Methods.WORKER_UPDATE,
+                Request(world=world[rows], start_y=-1, worker=i),
+            )
+            return res.work_slice
 
-            def scatter(i: int, client: RpcClient):
-                s, e = bounds[i]
-                rows = np.arange(s - 1, e + 1) % h
-                try:
-                    res = client.call(
-                        Methods.WORKER_UPDATE,
-                        Request(world=world[rows], start_y=-1, worker=i),
-                    )
-                    strips[i] = res.work_slice
-                except RpcError as e:
-                    errors.append(e)
-
-            threads = [
-                threading.Thread(target=scatter, args=(i, self.clients[i]))
-                for i in range(n)
-            ]
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join()
-            if errors:
+        # one pool per run, not n fresh threads per turn
+        with concurrent.futures.ThreadPoolExecutor(n) as pool:
+            for _ in range(req.turns):
                 with self._lock:
+                    while self._paused and not self._quit:
+                        self._control.wait()
                     if self._quit:
-                        break  # shutdown race: a quitting worker dropped a call
-                raise RpcError(f"worker failed mid-run: {errors[0]}")
+                        break
+                    world = self._world
 
-            new_world = np.concatenate(strips, axis=0)
-            with self._lock:
-                self._world = new_world
-                self._turn += 1
+                try:
+                    strips = list(
+                        pool.map(scatter, ((i, world) for i in range(n)))
+                    )
+                except RpcError as e:
+                    with self._lock:
+                        if self._quit:
+                            break  # shutdown race: a quitting worker dropped a call
+                    raise RpcError(f"worker failed mid-run: {e}") from e
+
+                new_world = np.concatenate(strips, axis=0)
+                with self._lock:
+                    self._world = new_world
+                    self._turn += 1
 
     def pause(self):
         with self._lock:

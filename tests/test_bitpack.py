@@ -1,0 +1,127 @@
+"""Bitboard data-plane tests: packing, the carry-save adder step, the
+pallas kernel (interpret mode), and automatic plane selection."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from gol_distributed_final_tpu.models import CONWAY, HIGHLIFE
+from gol_distributed_final_tpu.ops import bitpack
+from gol_distributed_final_tpu.ops.auto import auto_step_n_fn
+from gol_distributed_final_tpu.ops.pallas_stencil import pallas_bit_step_n_fn
+
+from oracle import vector_step
+
+
+def random_board(h, w, seed=0, density=0.35):
+    rng = np.random.default_rng(seed)
+    return np.where(rng.random((h, w)) < density, 255, 0).astype(np.uint8)
+
+
+@pytest.mark.parametrize("word_axis", [0, 1])
+@pytest.mark.parametrize("shape", [(32, 32), (64, 96), (96, 64), (32, 256)])
+def test_pack_roundtrip(word_axis, shape):
+    board = random_board(*shape, seed=shape[0] + word_axis)
+    packed = bitpack.pack(board, word_axis)
+    np.testing.assert_array_equal(bitpack.unpack(packed, word_axis), board)
+
+
+def test_pack_rejects_indivisible():
+    with pytest.raises(ValueError, match="not divisible"):
+        bitpack.pack(random_board(33, 32), word_axis=0)
+    with pytest.raises(ValueError, match="not divisible"):
+        bitpack.pack(random_board(32, 33), word_axis=1)
+
+
+@pytest.mark.parametrize("word_axis", [0, 1])
+def test_bit_step_matches_oracle(word_axis):
+    board = random_board(64, 96, seed=3)
+    packed = bitpack.pack(board, word_axis)
+    want = board
+    for turn in range(5):
+        packed = jnp.asarray(bitpack.bit_step(packed, word_axis))
+        want = vector_step(want)
+        got = bitpack.unpack(np.asarray(packed), word_axis)
+        np.testing.assert_array_equal(got, want, err_msg=f"turn {turn}")
+
+
+def test_bit_step_n_long_run_golden():
+    """1000 turns on the shipped 64x64 board must match the golden CSV."""
+    from gol_distributed_final_tpu.io.pgm import read_pgm
+
+    from helpers import REPO_ROOT, read_alive_counts
+
+    counts = read_alive_counts(REPO_ROOT / "check" / "alive" / "64x64.csv")
+    board = read_pgm(REPO_ROOT / "images" / "64x64.pgm")
+    packed = bitpack.pack(board, 0)
+    for n in (1, 100, 1000):
+        out = bitpack.bit_step_n(bitpack.pack(board, 0), n, 0)
+        alive = int(np.count_nonzero(bitpack.unpack(np.asarray(out), 0)))
+        assert alive == counts[n], f"turn {n}: {alive} != {counts[n]}"
+
+
+def test_packed_step_n_fn_engine_shape():
+    fn = bitpack.packed_step_n_fn(0)
+    board = random_board(32, 64, seed=9)
+    out = np.asarray(fn(board, 7))
+    want = board
+    for _ in range(7):
+        want = vector_step(want)
+    np.testing.assert_array_equal(out, want)
+    assert out.dtype == np.uint8
+
+
+@pytest.mark.parametrize("word_axis", [0, 1])
+def test_pallas_bit_kernel_interpret(word_axis):
+    """The pallas kernel path, run in interpreter mode on CPU."""
+    fn = pallas_bit_step_n_fn(word_axis=word_axis, interpret=True)
+    board = random_board(32, 32, seed=4)
+    got = np.asarray(fn(board, 3))
+    want = board
+    for _ in range(3):
+        want = vector_step(want)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_auto_plane_selection():
+    # Conway + divisible height -> a bit plane (XLA flavour on CPU)
+    assert auto_step_n_fn(CONWAY, (64, 64)) is not None
+    assert auto_step_n_fn(CONWAY, (64, 50)) is not None  # h % 32 == 0
+    assert auto_step_n_fn(CONWAY, (50, 64)) is not None  # w % 32 == 0
+    # indivisible or non-Conway -> None (roll stencil handles it)
+    assert auto_step_n_fn(CONWAY, (50, 50)) is None
+    assert auto_step_n_fn(HIGHLIFE, (64, 64)) is None
+
+
+def test_engine_auto_fast_golden(tmp_path):
+    """Engine auto-selects the bit plane; run must stay golden-exact, and
+    disabling auto_fast must agree."""
+    import queue
+
+    from gol_distributed_final_tpu import FinalTurnComplete, Params, run
+    from gol_distributed_final_tpu.engine.engine import EngineConfig
+    from gol_distributed_final_tpu.engine.controller import CLOSED
+
+    from helpers import REPO_ROOT, assert_equal_board, read_alive_cells
+
+    expected = read_alive_cells(REPO_ROOT / "check" / "images" / "64x64x100.pgm")
+    for auto in (True, False):
+        p = Params(turns=100, image_width=64, image_height=64)
+        events = queue.Queue()
+        run(
+            p,
+            events,
+            engine_config=EngineConfig(auto_fast=auto),
+            images_dir=REPO_ROOT / "images",
+            out_dir=tmp_path / f"out{auto}",
+            tick_seconds=3600,
+        )
+        final = None
+        while True:
+            ev = events.get_nowait()
+            if ev is CLOSED:
+                break
+            if isinstance(ev, FinalTurnComplete):
+                final = ev
+        assert_equal_board(final.alive, expected, 64, 64)
