@@ -76,16 +76,29 @@ def main(argv=None) -> int:
             target=_stdin_keys, args=(keypresses, done), daemon=True
         ).start()
 
-    def consume():
-        for ev in iter_events(events):
-            text = str(ev)
-            if text:
-                print(f"Completed Turns {ev.get_completed_turns()} {text}")
+    if args.noVis:
+        # headless drain (main.go:59-67)
+        def consume():
+            for ev in iter_events(events):
+                text = str(ev)
+                if text:
+                    print(f"Completed Turns {ev.get_completed_turns()} {text}")
 
-    consumer = threading.Thread(target=consume)
+        consumer = threading.Thread(target=consume)
+    else:
+        # visualiser loop (main.go:57, sdl.Run); headless window fallback
+        # when the native SDL backend isn't built
+        from .viz import run as viz_run
+
+        consumer = threading.Thread(
+            target=viz_run, args=(params, events, keypresses)
+        )
     consumer.start()
     try:
-        run(params, events, keypresses, broker=broker)
+        # the in-process engine can feed the visualiser per-cell flips; the
+        # remote path (like the reference's distributed mode) cannot
+        emit_flips = not args.noVis and broker is None
+        run(params, events, keypresses, broker=broker, emit_flips=emit_flips)
     finally:
         done.set()
         consumer.join()

@@ -1,0 +1,119 @@
+"""ctypes binding to the native PGM codec (native/pgm_codec.cc).
+
+Auto-builds ``libgolio.so`` with g++ on first use (cached); every entry
+point degrades to None so io/pgm.py can fall back to the pure-Python codec
+when no compiler or build fails. pybind11 is not in the image, hence the
+plain C ABI + ctypes (environment constraint).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import pathlib
+import subprocess
+import threading
+
+import numpy as np
+
+_NATIVE_DIR = pathlib.Path(__file__).resolve().parent.parent / "native"
+_LIB_PATH = _NATIVE_DIR / "libgolio.so"
+
+_lock = threading.Lock()
+_lib = None
+_lib_tried = False
+
+
+def _load():
+    global _lib, _lib_tried
+    with _lock:
+        if _lib_tried:
+            return _lib
+        _lib_tried = True
+        if os.environ.get("GOL_TPU_NO_NATIVE"):
+            return None
+        if not _LIB_PATH.exists():
+            try:
+                subprocess.run(
+                    ["make", "-s", "libgolio.so"],
+                    cwd=_NATIVE_DIR,
+                    check=True,
+                    capture_output=True,
+                    timeout=120,
+                )
+            except (OSError, subprocess.SubprocessError):
+                return None
+        try:
+            lib = ctypes.CDLL(str(_LIB_PATH))
+        except OSError:
+            return None
+        lib.golio_read_header.argtypes = [ctypes.c_char_p] + [
+            ctypes.POINTER(ctypes.c_long)
+        ] * 4
+        lib.golio_read_header.restype = ctypes.c_int
+        lib.golio_read_rows.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_long,
+            ctypes.c_long,
+            ctypes.c_long,
+            ctypes.c_long,
+            ctypes.POINTER(ctypes.c_ubyte),
+        ]
+        lib.golio_read_rows.restype = ctypes.c_int
+        lib.golio_write.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_long,
+            ctypes.c_long,
+            ctypes.POINTER(ctypes.c_ubyte),
+        ]
+        lib.golio_write.restype = ctypes.c_int
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def read_header(path) -> tuple[int, int, int, int] | None:
+    """(width, height, maxval, raster_offset) or None if unavailable/invalid."""
+    lib = _load()
+    if lib is None:
+        return None
+    w, h, m, off = (ctypes.c_long() for _ in range(4))
+    rc = lib.golio_read_header(str(path).encode(), w, h, m, off)
+    if rc != 0:
+        return None
+    return w.value, h.value, m.value, off.value
+
+
+def read_rows(path, offset: int, width: int, start: int, stop: int):
+    """uint8[stop-start, width] or None."""
+    lib = _load()
+    if lib is None:
+        return None
+    out = np.empty(((stop - start), width), np.uint8)
+    rc = lib.golio_read_rows(
+        str(path).encode(),
+        offset,
+        width,
+        start,
+        stop,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+    )
+    return out if rc == 0 else None
+
+
+def write_board(path, board: np.ndarray) -> bool:
+    """Write + fsync a full P5 board; False if unavailable/failed."""
+    lib = _load()
+    if lib is None:
+        return False
+    board = np.ascontiguousarray(board, np.uint8)
+    rc = lib.golio_write(
+        str(path).encode(),
+        board.shape[1],
+        board.shape[0],
+        board.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+    )
+    return rc == 0

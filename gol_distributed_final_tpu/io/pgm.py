@@ -26,6 +26,11 @@ class PgmError(Exception):
     """Raised on malformed or mismatching PGM input (gol/io.go panics)."""
 
 
+# boards at least this large route through the native C++ codec when it is
+# available (io/native.py auto-builds it; small boards aren't worth the hop)
+_NATIVE_THRESHOLD_BYTES = 1 << 20
+
+
 _WHITESPACE = b" \t\n\r\x0b\x0c"
 
 
@@ -98,6 +103,12 @@ class PgmReader:
         if not 0 <= start <= stop <= self.height:
             raise PgmError(f"row range [{start}, {stop}) outside board height {self.height}")
         n = stop - start
+        if n * self.width >= _NATIVE_THRESHOLD_BYTES:
+            from . import native
+
+            rows = native.read_rows(self.path, self._offset, self.width, start, stop)
+            if rows is not None:
+                return rows
         self._f.seek(self._offset + start * self.width)
         buf = self._f.read(n * self.width)
         if len(buf) != n * self.width:
@@ -169,6 +180,14 @@ def read_pgm(path, *, expect_width=None, expect_height=None) -> np.ndarray:
 def write_pgm(path, board: np.ndarray) -> None:
     """Write a whole ``uint8[H, W]`` board as P5 (fsynced)."""
     board = np.asarray(board, np.uint8)
+    if board.ndim != 2:
+        raise PgmError(f"board must be 2-D, got shape {board.shape}")
+    if board.nbytes >= _NATIVE_THRESHOLD_BYTES:
+        from . import native
+
+        pathlib.Path(path).parent.mkdir(parents=True, exist_ok=True)
+        if native.write_board(path, board):
+            return
     with PgmWriter(path, board.shape[1], board.shape[0]) as w:
         w.write_rows(board)
 

@@ -1,0 +1,118 @@
+"""The visualiser window — the reference SDL window's API
+(reference: sdl/window.go:10-104) over two backends:
+
+* ``Window``: headless, buffer-only — always available; what the tests and
+  -noVis runs use. Keeps the ARGB8888 pixel buffer and the exact
+  FlipPixel/SetPixel/CountPixels/ClearPixels semantics (including the
+  bounds panic, sdl/window.go FlipPixel).
+* ``SdlWindow``: delegates to the native SDL2 binding
+  (native/window.cc -> libgolwindow.so) when it has been built on a host
+  with libSDL2; ``make_window`` falls back to headless otherwise.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pathlib
+
+import numpy as np
+
+_NATIVE_DIR = pathlib.Path(__file__).resolve().parent.parent / "native"
+_WINDOW_LIB = _NATIVE_DIR / "libgolwindow.so"
+
+_WHITE = 0x00FFFFFF
+
+
+class Window:
+    """Headless ARGB8888 pixel buffer with the reference window API."""
+
+    def __init__(self, width: int, height: int, title: str = "GoL"):
+        self.width = width
+        self.height = height
+        self.title = title
+        self._pixels = np.zeros((height, width), np.uint32)
+        self.frames_rendered = 0
+
+    def _check(self, x: int, y: int):
+        if not (0 <= x < self.width and 0 <= y < self.height):
+            # the reference panics on out-of-bounds flips (sdl/window.go)
+            raise IndexError(f"pixel ({x}, {y}) outside {self.width}x{self.height}")
+
+    def flip_pixel(self, x: int, y: int):
+        self._check(x, y)
+        self._pixels[y, x] ^= _WHITE
+
+    def set_pixel(self, x: int, y: int, argb: int = _WHITE):
+        self._check(x, y)
+        self._pixels[y, x] = argb
+
+    def count_pixels(self) -> int:
+        return int(np.count_nonzero(self._pixels & _WHITE))
+
+    def clear_pixels(self):
+        self._pixels[:] = 0
+
+    def render_frame(self):
+        self.frames_rendered += 1
+
+    def poll_key(self) -> str | None:
+        return None
+
+    def destroy(self):
+        pass
+
+
+class SdlWindow(Window):
+    """Native SDL2-backed window (requires libgolwindow.so)."""
+
+    def __init__(self, width: int, height: int, title: str = "GoL"):
+        super().__init__(width, height, title)
+        lib = ctypes.CDLL(str(_WINDOW_LIB))
+        lib.golwin_create.restype = ctypes.c_void_p
+        lib.golwin_create.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_char_p]
+        lib.golwin_poll_key.restype = ctypes.c_int
+        self._lib = lib
+        self._handle = ctypes.c_void_p(
+            lib.golwin_create(width, height, title.encode())
+        )
+        if not self._handle:
+            raise RuntimeError("SDL window creation failed")
+
+    def flip_pixel(self, x, y):
+        super().flip_pixel(x, y)
+        self._lib.golwin_flip_pixel(self._handle, x, y)
+
+    def set_pixel(self, x, y, argb=_WHITE):
+        super().set_pixel(x, y, argb)
+        self._lib.golwin_set_pixel(self._handle, x, y, ctypes.c_uint32(argb))
+
+    def clear_pixels(self):
+        super().clear_pixels()
+        self._lib.golwin_clear_pixels(self._handle)
+
+    def render_frame(self):
+        super().render_frame()
+        self._lib.golwin_render_frame(self._handle)
+
+    def poll_key(self) -> str | None:
+        code = self._lib.golwin_poll_key(self._handle)
+        if code == -1:
+            return "q"  # window close quits the controller
+        if code <= 0:
+            return None
+        return chr(code)
+
+    def destroy(self):
+        if self._handle:
+            self._lib.golwin_destroy(self._handle)
+            self._handle = None
+
+
+def make_window(width: int, height: int, title: str = "GoL") -> Window:
+    """SDL if the native backend was built on this host, else headless."""
+    if _WINDOW_LIB.exists():
+        try:
+            return SdlWindow(width, height, title)
+        except (OSError, RuntimeError):
+            pass
+    return Window(width, height, title)

@@ -1,0 +1,78 @@
+"""Visualiser tests: window semantics (sdl/window.go) and the event loop's
+flip/render protocol (sdl/loop.go), including the TestSdl-style shadow
+reconstruction through a full session."""
+
+import queue
+import threading
+
+import numpy as np
+import pytest
+
+from gol_distributed_final_tpu import Params, run
+from gol_distributed_final_tpu.viz import Window
+from gol_distributed_final_tpu.viz.loop import run as viz_run
+
+from helpers import REPO_ROOT, read_alive_counts
+
+
+def test_window_flip_set_count_clear():
+    w = Window(8, 4)
+    w.flip_pixel(0, 0)
+    w.flip_pixel(7, 3)
+    assert w.count_pixels() == 2
+    w.flip_pixel(0, 0)  # flip back off
+    assert w.count_pixels() == 1
+    w.set_pixel(2, 2)
+    assert w.count_pixels() == 2
+    w.clear_pixels()
+    assert w.count_pixels() == 0
+
+
+def test_window_bounds_panic():
+    w = Window(8, 4)
+    with pytest.raises(IndexError):
+        w.flip_pixel(8, 0)
+    with pytest.raises(IndexError):
+        w.flip_pixel(0, -1)
+
+
+def test_viz_loop_reconstructs_board(tmp_path):
+    """TestSdl's contract through the real stack: a session with flips on,
+    consumed by the visualiser loop; the window's pixel count at every
+    TurnComplete must match the golden alive CSV (sdl_test.go:56-74)."""
+    counts = read_alive_counts(REPO_ROOT / "check" / "alive" / "64x64.csv")
+    p = Params(turns=20, image_width=64, image_height=64)
+    events = queue.Queue()
+    seen = []
+
+    window = Window(64, 64)
+    viz_thread = threading.Thread(
+        target=viz_run,
+        args=(p, events),
+        kwargs={
+            "window": window,
+            "on_turn": lambda w, turn: seen.append((turn, w.count_pixels())),
+        },
+    )
+    viz_thread.start()
+    run(
+        p,
+        events,
+        emit_flips=True,
+        images_dir=REPO_ROOT / "images",
+        out_dir=tmp_path / "out",
+        tick_seconds=3600,
+    )
+    viz_thread.join(timeout=30)
+    assert not viz_thread.is_alive()
+    assert [t for t, _ in seen] == list(range(1, 21))
+    for turn, count in seen:
+        assert count == counts[turn], f"turn {turn}: {count} != {counts[turn]}"
+    assert window.frames_rendered == 20
+
+
+def test_make_window_falls_back_headless():
+    from gol_distributed_final_tpu.viz.window import make_window
+
+    w = make_window(4, 4)
+    assert isinstance(w, Window)  # no libSDL2 in this image
