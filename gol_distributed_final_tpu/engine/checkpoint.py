@@ -1,0 +1,39 @@
+"""Checkpoint / resume — a superset of the reference's snapshot mechanism.
+
+The reference's only "checkpoint" is the PGM snapshot ('s' writes
+out/<W>x<H>x<Turns>.pgm, gol/distributor.go:78-90); there is no resume —
+input is always images/<W>x<H>.pgm and the turn counter starts at 0
+(SURVEY.md §5). Here a checkpoint carries the board, the turn counter, and
+the rule, so a run can continue exactly where it stopped: bit-identical to
+an uninterrupted run (tests/test_checkpoint.py).
+
+Format: a plain .npz — board (uint8 [H, W]), turn (int), rulestring (str).
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+import numpy as np
+
+from ..models import CONWAY, LifeRule
+
+
+def save_checkpoint(path, world, turn: int, rule: LifeRule = CONWAY) -> pathlib.Path:
+    path = pathlib.Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    np.savez_compressed(
+        path,
+        board=np.asarray(world, np.uint8),
+        turn=np.int64(turn),
+        rulestring=np.str_(rule.rulestring),
+    )
+    return path if path.suffix == ".npz" else path.with_suffix(path.suffix + ".npz")
+
+
+def load_checkpoint(path) -> tuple[np.ndarray, int, LifeRule]:
+    with np.load(path, allow_pickle=False) as data:
+        board = data["board"].astype(np.uint8)
+        turn = int(data["turn"])
+        rule = LifeRule.from_rulestring(str(data["rulestring"]))
+    return board, turn, rule

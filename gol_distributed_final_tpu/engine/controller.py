@@ -60,8 +60,16 @@ class InProcessBroker:
     def __init__(self, engine: Engine | None = None):
         self.engine = engine or Engine()
 
-    def run(self, params, world, *, emit=None, emit_flips=False) -> RunResult:
-        return self.engine.run(params, world, emit=emit, emit_flips=emit_flips)
+    def run(
+        self, params, world, *, emit=None, emit_flips=False, initial_turn=0
+    ) -> RunResult:
+        return self.engine.run(
+            params,
+            world,
+            emit=emit,
+            emit_flips=emit_flips,
+            initial_turn=initial_turn,
+        )
 
     def pause(self):
         return self.engine.pause()
@@ -175,6 +183,7 @@ def run(
     images_dir="images",
     out_dir="out",
     tick_seconds: float = 2.0,
+    resume_from=None,
 ) -> RunResult:
     """Run a full Game of Life session (gol.Run + distributor, gol/gol.go:12).
 
@@ -184,11 +193,24 @@ def run(
 
     ``broker`` selects the backend: None for an in-process engine, or any
     object with the stubs verb surface (e.g. rpc.client.RemoteBroker).
+
+    ``resume_from`` continues from a checkpoint (engine/checkpoint.py)
+    instead of loading images/<W>x<H>.pgm at turn 0 — the capability the
+    reference lacks (SURVEY.md §5 checkpoint/resume).
     """
+    initial_turn = 0
+    ckpt_rule = None
+    if resume_from is not None:
+        from .checkpoint import load_checkpoint
+
+        ckpt_world, initial_turn, ckpt_rule = load_checkpoint(resume_from)
+
     if events is None:
         events = queue.Queue()
     if engine_config is None:
-        engine_config = EngineConfig(rule=rule if rule is not None else CONWAY)
+        engine_config = EngineConfig(
+            rule=rule if rule is not None else (ckpt_rule or CONWAY)
+        )
     elif rule is not None:
         raise ValueError(
             "pass the rule inside engine_config (EngineConfig(rule=...)); "
@@ -199,7 +221,7 @@ def run(
 
     ticker = None
     try:
-        world = read_board(params, images_dir)
+        world = ckpt_world if resume_from is not None else read_board(params, images_dir)
         ticker = _Ticker(params, events, keypresses, broker, out_dir, tick_seconds)
         ticker.start()
         result = broker.run(
@@ -207,6 +229,7 @@ def run(
             world,
             emit=events.put if emit_flips else None,
             emit_flips=emit_flips,
+            initial_turn=initial_turn,
         )
         # join the ticker BEFORE the closing sequence so no stray
         # AliveCellsCount can interleave after StateChange{Quitting}

@@ -112,6 +112,7 @@ class Engine:
         emit: Optional[Callable] = None,
         emit_flips: bool = False,
         step_n_fn: Optional[Callable] = None,
+        initial_turn: int = 0,
     ) -> RunResult:
         """Blocking: evolve ``world`` for ``params.turns`` turns (or until
         quit). Resets the turn counter — a reattaching controller starts a
@@ -146,7 +147,9 @@ class Engine:
             self._board_dev = jnp.asarray(world)
             self._world_host = world
             self._host_dirty = False
-            self._turn = 0
+            # 0 for a fresh run (the reference's reset-on-Run semantics,
+            # broker/broker.go:64); a checkpoint's turn for a resume
+            self._turn = initial_turn
             # _quit/_paused are NOT reset here: a quit() or pause() issued
             # after the controller started its ticker but before the run
             # loop initialised must still take effect (they are consumed /

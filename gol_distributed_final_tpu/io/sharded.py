@@ -1,0 +1,61 @@
+"""Shard-streamed PGM IO for boards larger than any single host's memory.
+
+The reference materialises the full board in the controller, the broker,
+AND every worker (SURVEY.md §5 long-context note) — board size is capped
+by one machine's RAM. Here each host reads and writes only its own row
+range of the on-disk PGM (the BASELINE.json 65536^2 config: a ~4 GiB
+raster that never exists in one piece in memory):
+
+* ``create_pgm`` writes the header and pre-sizes the file;
+* ``write_rows_at`` lets each host pwrite its rows at the right offset
+  (safe concurrently — ranges are disjoint);
+* reading a shard is ``PgmReader.read_rows`` (io/pgm.py), which seeks
+  straight to the range (native-codec-accelerated beyond 1 MiB).
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+
+import numpy as np
+
+from .pgm import PgmError, PgmReader
+
+
+def create_pgm(path, width: int, height: int) -> int:
+    """Write the P5 header and pre-size the raster; returns raster offset."""
+    path = pathlib.Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    header = b"P5\n%d %d\n255\n" % (width, height)
+    with open(path, "wb") as f:
+        f.write(header)
+        f.truncate(len(header) + width * height)
+    return len(header)
+
+
+def write_rows_at(path, raster_offset: int, width: int, start_row: int, rows) -> None:
+    """pwrite ``rows`` (uint8 [n, width]) at their offset in the raster."""
+    rows = np.ascontiguousarray(rows, np.uint8)
+    if rows.ndim != 2 or rows.shape[1] != width:
+        raise PgmError(f"row block shape {rows.shape} does not match width {width}")
+    fd = os.open(str(path), os.O_WRONLY)
+    try:
+        os.pwrite(fd, rows.tobytes(), raster_offset + start_row * width)
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def read_shard(path, start_row: int, stop_row: int) -> np.ndarray:
+    """This host's row range of an on-disk board."""
+    with PgmReader(path) as r:
+        return r.read_rows(start_row, stop_row)
+
+
+def write_board_sharded(path, width: int, height: int, shards) -> None:
+    """Convenience single-process form: ``shards`` is an iterable of
+    (start_row, rows) pairs; creates the file, then streams each shard."""
+    offset = create_pgm(path, width, height)
+    for start_row, rows in shards:
+        write_rows_at(path, offset, width, start_row, rows)

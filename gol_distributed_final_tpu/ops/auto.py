@@ -3,11 +3,11 @@
 Given a rule and board geometry, pick the fastest correct single-device
 step implementation available:
 
-* Conway + a 32-divisible axis + TPU  -> the pallas VMEM bitboard kernel
-  (~40x the roll stencil on v5e);
-* Conway + a 32-divisible axis       -> the XLA bitboard step;
-* anything else                       -> None (caller falls back to the
-  roll-based stencil, which handles every rule and geometry).
+* any life-like rule + a 32-divisible axis + TPU -> the pallas VMEM
+  bitboard kernel (~40x the roll stencil on v5e);
+* any life-like rule + a 32-divisible axis       -> the XLA bitboard step;
+* indivisible geometry                            -> None (caller falls
+  back to the roll-based stencil, which handles every geometry).
 """
 
 from __future__ import annotations
@@ -16,16 +16,9 @@ from typing import Callable, Optional
 
 import jax
 
-from .stencil import CONWAY_BIRTH_MASK, CONWAY_SURVIVE_MASK
-
 
 def auto_step_n_fn(rule, shape: tuple[int, int]) -> Optional[Callable]:
     """An engine-compatible ``(board_uint8, n) -> board_uint8`` or None."""
-    if (rule.birth_mask, rule.survive_mask) != (
-        CONWAY_BIRTH_MASK,
-        CONWAY_SURVIVE_MASK,
-    ):
-        return None  # bit kernels encode Conway's T==3/T==4 rule only
     h, w = shape
     if h % 32 == 0:
         word_axis = 0  # rows packed: [H/32, W] keeps lanes wide on TPU
@@ -37,8 +30,8 @@ def auto_step_n_fn(rule, shape: tuple[int, int]) -> Optional[Callable]:
     if jax.devices()[0].platform == "tpu":
         from .pallas_stencil import pallas_bit_step_n_fn
 
-        return pallas_bit_step_n_fn(word_axis=word_axis, interpret=False)
+        return pallas_bit_step_n_fn(word_axis=word_axis, interpret=False, rule=rule)
 
     from .bitpack import packed_step_n_fn
 
-    return packed_step_n_fn(word_axis)
+    return packed_step_n_fn(word_axis, rule=rule)

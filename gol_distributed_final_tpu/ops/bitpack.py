@@ -87,11 +87,35 @@ def _full_adder3(a, b, c):
     return axb ^ c, (a & b) | (c & axb)
 
 
-def bit_step(packed, word_axis: int = 0, rot1=None):
-    """One Conway turn on an int32 bitboard.
+from .stencil import CONWAY_BIRTH_MASK, CONWAY_SURVIVE_MASK
+
+
+def _rule_planes(birth_mask: int, survive_mask: int):
+    """T-value sets for a B/S rule, where T = 3x3 sum INCLUDING the cell.
+
+    A dead cell has T = neighbours, a live cell T = neighbours + 1, so:
+    dead next-alive iff T in birth; live next-alive iff (T-1) in survive.
+    """
+    dead_ts = [t for t in range(9) if birth_mask >> t & 1]
+    live_ts = [t + 1 for t in range(9) if survive_mask >> t & 1]
+    return dead_ts, live_ts
+
+
+def bit_step(
+    packed,
+    word_axis: int = 0,
+    rot1=None,
+    *,
+    birth_mask: int = CONWAY_BIRTH_MASK,
+    survive_mask: int = CONWAY_SURVIVE_MASK,
+):
+    """One life-like turn on an int32 bitboard.
 
     ``rot1(a, shift, axis)`` overrides the cyclic rotate primitive
-    (e.g. a pltpu.roll wrapper inside pallas kernels).
+    (e.g. a pltpu.roll wrapper inside pallas kernels). The rule is encoded
+    as equality tests on the 4 bitplanes of the inclusive 3x3 sum T —
+    Conway's B3/S23 needs exactly two (T==3, alive&T==4); other rules cost
+    ~4 ops per additional member of the birth/survive sets.
     """
     rot = rot1 or _default_rot1
     elem_axis = 1 - word_axis
@@ -120,26 +144,55 @@ def bit_step(packed, word_axis: int = 0, rot1=None):
     c_c = a_c & b_s  # weight-4 carry
     t2 = b_c ^ c_c  # weight-4 plane
     t3 = b_c & c_c  # weight-8 plane
+    planes = (a_s, c_s, t2, t3)  # T = p0 + 2*p1 + 4*p2 + 8*p3, T in 0..9
 
-    # T == 3 (0b0011) births and keeps; T == 4 (0b0100) keeps the living
-    # (T counts the cell itself, so alive & T==4 <=> exactly 3 neighbours)
-    eq3 = a_s & c_s & ~t2 & ~t3
-    eq4 = ~a_s & ~c_s & t2 & ~t3
-    return eq3 | (mid & eq4)
+    def eq(value: int):
+        acc = None
+        for bit, plane in enumerate(planes):
+            term = plane if value >> bit & 1 else ~plane
+            acc = term if acc is None else acc & term
+        return acc
+
+    def any_eq(values):
+        acc = None
+        for v in values:
+            acc = eq(v) if acc is None else acc | eq(v)
+        return acc
+
+    dead_ts, live_ts = _rule_planes(birth_mask, survive_mask)
+    zero = packed ^ packed  # a zero of the right dtype/shape
+    born = any_eq(dead_ts) if dead_ts else zero
+    kept = any_eq(live_ts) if live_ts else zero
+    return (~mid & born) | (mid & kept)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2))
-def bit_step_n(packed, n: int, word_axis: int = 0):
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
+def bit_step_n(
+    packed,
+    n: int,
+    word_axis: int = 0,
+    birth_mask: int = CONWAY_BIRTH_MASK,
+    survive_mask: int = CONWAY_SURVIVE_MASK,
+):
     """n turns on the bitboard in one dispatch."""
-    return lax.fori_loop(0, n, lambda _, b: bit_step(b, word_axis), packed)
+    return lax.fori_loop(
+        0,
+        n,
+        lambda _, b: bit_step(
+            b, word_axis, birth_mask=birth_mask, survive_mask=survive_mask
+        ),
+        packed,
+    )
 
 
-def packed_step_n_fn(word_axis: int = 0):
+def packed_step_n_fn(word_axis: int = 0, rule=None):
     """Engine-compatible ``(board_uint8, n) -> board_uint8``: pack, evolve
-    on the bitboard, unpack — the fast Conway data plane on any backend."""
+    on the bitboard, unpack — the fast life-like data plane on any backend."""
+    birth = rule.birth_mask if rule else CONWAY_BIRTH_MASK
+    survive = rule.survive_mask if rule else CONWAY_SURVIVE_MASK
 
     def step_n(board, n):
-        out = bit_step_n(pack(board, word_axis), int(n), word_axis)
+        out = bit_step_n(pack(board, word_axis), int(n), word_axis, birth, survive)
         return jnp.asarray(unpack(out, word_axis))
 
     return step_n

@@ -116,7 +116,9 @@ def _compiled(n: int, birth_mask: int, survive_mask: int, interpret: bool):
     return run
 
 
-def _bit_kernel(packed_ref, out_ref, *, n, word_axis, interpret):
+def _bit_kernel(
+    packed_ref, out_ref, *, n, word_axis, interpret, birth_mask, survive_mask
+):
     from .bitpack import bit_step
 
     if interpret:
@@ -126,16 +128,34 @@ def _bit_kernel(packed_ref, out_ref, *, n, word_axis, interpret):
         rot1 = functools.partial(_rot1, interpret=False)
 
     out_ref[:] = lax.fori_loop(
-        0, n, lambda _, b: bit_step(b, word_axis, rot1), packed_ref[:]
+        0,
+        n,
+        lambda _, b: bit_step(
+            b, word_axis, rot1, birth_mask=birth_mask, survive_mask=survive_mask
+        ),
+        packed_ref[:],
     )
 
 
 @functools.lru_cache(maxsize=None)
-def _bit_compiled(n: int, word_axis: int, interpret: bool):
+def _bit_compiled(
+    n: int,
+    word_axis: int,
+    interpret: bool,
+    birth_mask: int | None = None,
+    survive_mask: int | None = None,
+):
     from jax.experimental import pallas as pl
 
+    from .stencil import CONWAY_BIRTH_MASK, CONWAY_SURVIVE_MASK
+
     kernel = functools.partial(
-        _bit_kernel, n=n, word_axis=word_axis, interpret=interpret
+        _bit_kernel,
+        n=n,
+        word_axis=word_axis,
+        interpret=interpret,
+        birth_mask=CONWAY_BIRTH_MASK if birth_mask is None else birth_mask,
+        survive_mask=CONWAY_SURVIVE_MASK if survive_mask is None else survive_mask,
     )
 
     @jax.jit
@@ -157,7 +177,9 @@ def _bit_compiled(n: int, word_axis: int, interpret: bool):
     return run
 
 
-def pallas_bit_step_n_fn(*, word_axis: int = 0, interpret: bool | None = None):
+def pallas_bit_step_n_fn(
+    *, word_axis: int = 0, interpret: bool | None = None, rule=None
+):
     """Conway on the VMEM-resident int32 bitboard: 32 cells/word, the whole
     n-turn evolution in ONE kernel launch — bitwise adder trees on (8,128)
     int32 vregs, HBM touched twice total. The fastest single-device path:
@@ -170,7 +192,10 @@ def pallas_bit_step_n_fn(*, word_axis: int = 0, interpret: bool | None = None):
     Engine-compatible ``(board_uint8, n) -> board_uint8``.
     """
     from .bitpack import bit_step_n, pack, unpack
+    from .stencil import CONWAY_BIRTH_MASK, CONWAY_SURVIVE_MASK
 
+    birth = rule.birth_mask if rule else CONWAY_BIRTH_MASK
+    survive = rule.survive_mask if rule else CONWAY_SURVIVE_MASK
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
 
@@ -178,9 +203,9 @@ def pallas_bit_step_n_fn(*, word_axis: int = 0, interpret: bool | None = None):
         n = int(n)
         packed = pack(board, word_axis)
         if not fits_vmem(packed.shape):  # int32 words: limit is generous
-            out = bit_step_n(packed, n, word_axis)
+            out = bit_step_n(packed, n, word_axis, birth, survive)
         else:
-            out = _bit_compiled(n, word_axis, interpret)(packed)
+            out = _bit_compiled(n, word_axis, interpret, birth, survive)(packed)
         return jnp.asarray(unpack(out, word_axis))
 
     return step_n

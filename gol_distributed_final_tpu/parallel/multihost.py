@@ -1,0 +1,72 @@
+"""Multi-host scaffolding: process initialisation and per-host shard maths.
+
+The reference scales to more machines by adding worker addresses to a
+hardcoded list (broker/broker.go:288-300) and paying O(H x W) wire bytes
+per worker per turn. Here multi-host is a bigger mesh: processes join via
+``jax.distributed``, the board is sharded over a global ('rows', 'cols')
+mesh spanning all hosts, and per-turn communication stays O(perimeter)
+halo ppermutes — over ICI within a slice, DCN across hosts, inserted by
+XLA from the same shard_map program (SURVEY.md §2 backend table).
+
+For boards too large for any single host (BASELINE.json config 5:
+65536^2), each host touches only its own row range of the PGM through
+``host_row_range`` + io/sharded.py streamed IO.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from .mesh import ROWS
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Join the multi-host job (``jax.distributed.initialize``); no-op and
+    False for single-process runs so the same code path serves both."""
+    if num_processes is None or num_processes <= 1:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
+def host_row_range(mesh: Mesh, height: int) -> tuple[int, int]:
+    """The [start, stop) board rows this process's devices own under the
+    canonical board sharding — its slice of a streamed PGM read/write."""
+    n_rows = mesh.shape[ROWS]
+    if height % n_rows:
+        raise ValueError(f"height {height} does not divide over {n_rows} row shards")
+    block = height // n_rows
+    local = set(d.id for d in jax.local_devices())
+    mesh_rows = [
+        r
+        for r in range(n_rows)
+        if any(d.id in local for d in np.asarray(mesh.devices)[r].flatten())
+    ]
+    if not mesh_rows:
+        raise ValueError("this process owns no devices in the mesh")
+    lo, hi = min(mesh_rows), max(mesh_rows)
+    if set(range(lo, hi + 1)) != set(mesh_rows):
+        raise ValueError(
+            "this process's mesh rows are not contiguous; use a process-major "
+            "device order when building the mesh"
+        )
+    return lo * block, (hi + 1) * block
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()

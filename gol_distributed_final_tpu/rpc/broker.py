@@ -73,7 +73,9 @@ class TpuBackend:
             image_height=req.image_height,
         )
         step_fn = self._step_fn_for(req.image_height, req.image_width)
-        return self.engine.run(params, req.world, step_n_fn=step_fn)
+        return self.engine.run(
+            params, req.world, step_n_fn=step_fn, initial_turn=req.initial_turn
+        )
 
     def pause(self):
         self.engine.pause()
@@ -115,26 +117,15 @@ class WorkersBackend:
             raise RpcError("no workers connected")
         world = np.array(req.world, np.uint8, copy=True)
         h = world.shape[0]
-        n = max(1, min(req.threads or len(self.clients), len(self.clients), h))
         with self._lock:
             if self._running:
                 raise RpcError("a run is already in progress")
-            self._world, self._turn = world, 0
+            self._world, self._turn = world, req.initial_turn
             self._paused = False
             self._running = True
 
-        # row split: even shares, remainder to the first h % n workers
-        # (broker/broker.go:135-224)
-        base, rem = divmod(h, n)
-        bounds = []
-        y = 0
-        for i in range(n):
-            size = base + (1 if i < rem else 0)
-            bounds.append((y, y + size))
-            y += size
-
         try:
-            self._turn_loop(req, bounds, n, h)
+            self._turn_loop(req, h)
             # capture the result BEFORE clearing _running: once the flag
             # drops, a reattaching Run may overwrite _world/_turn
             with self._lock:
@@ -148,38 +139,76 @@ class WorkersBackend:
                 self._control.notify_all()
         return result
 
-    def _turn_loop(self, req: Request, bounds, n: int, h: int) -> None:
+    @staticmethod
+    def _split(h: int, n: int) -> list[tuple[int, int]]:
+        """Row split: even shares, remainder to the first h % n workers
+        (broker/broker.go:135-224)."""
+        base, rem = divmod(h, n)
+        bounds = []
+        y = 0
+        for i in range(n):
+            size = base + (1 if i < rem else 0)
+            bounds.append((y, y + size))
+            y += size
+        return bounds
+
+    def _turn_loop(self, req: Request, h: int) -> None:
+        """Per-turn scatter/gather with elastic recovery: a worker that dies
+        mid-run is dropped and its rows re-split over the survivors — the
+        fault-tolerance extension the reference leaves unimplemented
+        (README.md:266-270; its gather simply hangs on worker death)."""
         import concurrent.futures
 
-        def scatter(args):
-            i, world = args
-            s, e = bounds[i]
+        def scatter(client, world, s, e):
             rows = np.arange(s - 1, e + 1) % h
-            res = self.clients[i].call(
-                Methods.WORKER_UPDATE,
-                Request(world=world[rows], start_y=-1, worker=i),
+            res = client.call(
+                Methods.WORKER_UPDATE, Request(world=world[rows], start_y=-1)
             )
             return res.work_slice
 
+        active = list(self.clients)
+
+        def plan():
+            n = max(1, min(req.threads or len(active), len(active), h))
+            return n, self._split(h, n)
+
+        n, bounds = plan()
         # one pool per run, not n fresh threads per turn
-        with concurrent.futures.ThreadPoolExecutor(n) as pool:
-            for _ in range(req.turns):
+        with concurrent.futures.ThreadPoolExecutor(len(active)) as pool:
+            for _ in range(req.turns - req.initial_turn):
                 with self._lock:
                     while self._paused and not self._quit:
                         self._control.wait()
                     if self._quit:
-                        break
+                        return
                     world = self._world
 
-                try:
-                    strips = list(
-                        pool.map(scatter, ((i, world) for i in range(n)))
-                    )
-                except RpcError as e:
+                while True:  # retries the SAME turn after losing workers
+                    futures = [
+                        pool.submit(scatter, active[i], world, *bounds[i])
+                        for i in range(n)
+                    ]
+                    strips = [None] * n
+                    dead = []
+                    for i, fut in enumerate(futures):
+                        try:
+                            strips[i] = fut.result()
+                        except (RpcError, OSError):
+                            dead.append(i)
+                    if not dead:
+                        break
                     with self._lock:
                         if self._quit:
-                            break  # shutdown race: a quitting worker dropped a call
-                    raise RpcError(f"worker failed mid-run: {e}") from e
+                            return  # shutdown race, not a failure
+                    for i in sorted(dead, reverse=True):
+                        del active[i]
+                    if not active:
+                        raise RpcError("all workers lost mid-run")
+                    print(
+                        f"{len(dead)} worker(s) lost mid-run; "
+                        f"resplitting over {len(active)}"
+                    )
+                    n, bounds = plan()
 
                 new_world = np.concatenate(strips, axis=0)
                 with self._lock:

@@ -96,7 +96,7 @@ class RemoteBroker:
     def __init__(self, address: str = "127.0.0.1:8040", timeout: float | None = 10.0):
         self.client = RpcClient(address, timeout=timeout)
 
-    def run(self, params, world, *, emit=None, emit_flips=False):
+    def run(self, params, world, *, emit=None, emit_flips=False, initial_turn=0):
         # emit/emit_flips are single-host features; the distributed reference
         # never emits CellFlipped/TurnComplete either (SURVEY.md §4 TestSdl note)
         req = Request(
@@ -105,6 +105,7 @@ class RemoteBroker:
             image_height=params.image_height,
             image_width=params.image_width,
             threads=params.threads,
+            initial_turn=initial_turn,
         )
         res = self.client.call(Methods.BROKER_RUN, req)
         from ..engine.engine import RunResult

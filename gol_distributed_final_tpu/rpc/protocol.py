@@ -45,6 +45,7 @@ class Request:
     end_y: int = 0
     worker: int = 0
     include_world: bool = True  # extension: count-only Retrieve
+    initial_turn: int = 0  # extension: resume-from-checkpoint support
 
 
 @dataclasses.dataclass

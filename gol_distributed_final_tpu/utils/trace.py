@@ -1,0 +1,51 @@
+"""Profiling / tracing hooks — the TestTrace analogue (reference:
+trace_test.go:12-29 wraps a run in runtime/trace for goroutine inspection).
+
+Here the equivalent is a ``jax.profiler`` trace around any region: the
+resulting TensorBoard-format trace shows per-dispatch device timelines,
+compilations, and transfers.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import pathlib
+import time
+
+
+@contextlib.contextmanager
+def trace(log_dir="trace_out"):
+    """Context manager: profile everything inside into ``log_dir``."""
+    import jax
+
+    pathlib.Path(log_dir).mkdir(parents=True, exist_ok=True)
+    jax.profiler.start_trace(str(log_dir))
+    try:
+        yield pathlib.Path(log_dir)
+    finally:
+        jax.profiler.stop_trace()
+
+
+class TurnsPerSecond:
+    """Tiny throughput meter: feed completed-turn counts, read turns/sec
+    and cell-updates/sec (the driver metric, BASELINE.json)."""
+
+    def __init__(self, cells_per_turn: int):
+        self.cells_per_turn = cells_per_turn
+        self._t0 = time.monotonic()
+        self._turns = 0
+
+    def update(self, turns_completed: int):
+        self._turns = turns_completed
+
+    @property
+    def elapsed(self) -> float:
+        return time.monotonic() - self._t0
+
+    @property
+    def turns_per_second(self) -> float:
+        return self._turns / self.elapsed if self.elapsed else 0.0
+
+    @property
+    def cell_updates_per_second(self) -> float:
+        return self.turns_per_second * self.cells_per_turn

@@ -1,0 +1,111 @@
+"""Aux subsystems: checkpoint/resume, tracing, sharded IO, multi-host maths."""
+
+import queue
+
+import numpy as np
+import pytest
+
+from gol_distributed_final_tpu import Params, run
+from gol_distributed_final_tpu.engine.checkpoint import (
+    load_checkpoint,
+    save_checkpoint,
+)
+from gol_distributed_final_tpu.engine.engine import Engine
+from gol_distributed_final_tpu.io.pgm import read_pgm
+from gol_distributed_final_tpu.io.sharded import (
+    create_pgm,
+    read_shard,
+    write_board_sharded,
+    write_rows_at,
+)
+from gol_distributed_final_tpu.models import HIGHLIFE
+from gol_distributed_final_tpu.parallel import make_mesh
+from gol_distributed_final_tpu.parallel.multihost import host_row_range
+
+from helpers import REPO_ROOT, assert_equal_board, read_alive_cells
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    board = np.where(np.random.default_rng(0).random((32, 48)) < 0.4, 255, 0).astype(np.uint8)
+    p = save_checkpoint(tmp_path / "ck.npz", board, 123, HIGHLIFE)
+    world, turn, rule = load_checkpoint(tmp_path / "ck.npz")
+    np.testing.assert_array_equal(world, board)
+    assert turn == 123
+    assert rule.rulestring == "B36/S23"
+
+
+def test_resume_equals_uninterrupted_run(tmp_path):
+    """Stop at turn 40, checkpoint, resume to 100: final board and events
+    must match an uninterrupted 100-turn run exactly."""
+    # leg 1: run 40 turns on the engine directly
+    engine = Engine()
+    p40 = Params(turns=40, image_width=64, image_height=64)
+    world0 = read_pgm(REPO_ROOT / "images" / "64x64.pgm")
+    leg1 = engine.run(p40, world0)
+    ck = save_checkpoint(tmp_path / "ck.npz", leg1.world, leg1.turns_completed)
+
+    # leg 2: resume through the full controller to turn 100
+    p100 = Params(turns=100, image_width=64, image_height=64)
+    events = queue.Queue()
+    result = run(
+        p100,
+        events,
+        resume_from=tmp_path / "ck.npz",
+        images_dir=REPO_ROOT / "images",
+        out_dir=tmp_path / "out",
+        tick_seconds=3600,
+    )
+    assert result.turns_completed == 100
+    expected = read_alive_cells(REPO_ROOT / "check" / "images" / "64x64x100.pgm")
+    assert_equal_board(result.alive, expected, 64, 64)
+
+
+def test_trace_produces_profile(tmp_path):
+    import jax.numpy as jnp
+
+    from gol_distributed_final_tpu.models import CONWAY
+    from gol_distributed_final_tpu.utils.trace import trace
+
+    board = jnp.zeros((32, 32), jnp.uint8)
+    with trace(tmp_path / "tr") as d:
+        CONWAY.step_n(board, 3).block_until_ready()
+    produced = list(d.rglob("*"))
+    assert any(f.is_file() for f in produced), "no trace artifacts written"
+
+
+def test_turns_per_second_meter():
+    from gol_distributed_final_tpu.utils.trace import TurnsPerSecond
+
+    m = TurnsPerSecond(cells_per_turn=512 * 512)
+    m.update(100)
+    assert m.turns_per_second > 0
+    assert m.cell_updates_per_second == m.turns_per_second * 512 * 512
+
+
+def test_sharded_pgm_roundtrip(tmp_path):
+    rng = np.random.default_rng(1)
+    board = np.where(rng.random((64, 48)) < 0.5, 255, 0).astype(np.uint8)
+    path = tmp_path / "sharded.pgm"
+    # two "hosts" write disjoint halves, out of order
+    offset = create_pgm(path, 48, 64)
+    write_rows_at(path, offset, 48, 32, board[32:])
+    write_rows_at(path, offset, 48, 0, board[:32])
+    np.testing.assert_array_equal(read_pgm(path), board)
+    np.testing.assert_array_equal(read_shard(path, 16, 48), board[16:48])
+
+
+def test_write_board_sharded_convenience(tmp_path):
+    board = np.arange(32 * 32, dtype=np.uint32).astype(np.uint8).reshape(32, 32)
+    path = tmp_path / "conv.pgm"
+    write_board_sharded(path, 32, 32, [(16, board[16:]), (0, board[:16])])
+    np.testing.assert_array_equal(read_pgm(path), board)
+
+
+def test_host_row_range_single_process():
+    # single process owns all devices => the whole board
+    mesh = make_mesh((4, 2))
+    assert host_row_range(mesh, 64) == (0, 64)
+    mesh1d = make_mesh((8, 1))
+    assert host_row_range(mesh1d, 64) == (0, 64)
+    with pytest.raises(ValueError, match="does not divide"):
+        host_row_range(mesh, 30)

@@ -85,13 +85,46 @@ def test_pallas_bit_kernel_interpret(word_axis):
 
 
 def test_auto_plane_selection():
-    # Conway + divisible height -> a bit plane (XLA flavour on CPU)
+    # any life-like rule + divisible axis -> a bit plane (XLA flavour on CPU)
     assert auto_step_n_fn(CONWAY, (64, 64)) is not None
     assert auto_step_n_fn(CONWAY, (64, 50)) is not None  # h % 32 == 0
     assert auto_step_n_fn(CONWAY, (50, 64)) is not None  # w % 32 == 0
-    # indivisible or non-Conway -> None (roll stencil handles it)
+    assert auto_step_n_fn(HIGHLIFE, (64, 64)) is not None
+    # indivisible -> None (roll stencil handles it)
     assert auto_step_n_fn(CONWAY, (50, 50)) is None
-    assert auto_step_n_fn(HIGHLIFE, (64, 64)) is None
+
+
+@pytest.mark.parametrize(
+    "rulename,birth,survive",
+    [
+        ("highlife", (3, 6), (2, 3)),
+        ("seeds", (2,), ()),
+        ("day-and-night", (3, 6, 7, 8), (3, 4, 6, 7, 8)),
+    ],
+)
+def test_bit_step_general_rules(rulename, birth, survive):
+    from gol_distributed_final_tpu.models import LifeRule
+
+    rule = LifeRule.from_rulestring(
+        "B" + "".join(map(str, birth)) + "/S" + "".join(map(str, survive))
+    )
+    fn = bitpack.packed_step_n_fn(0, rule=rule)
+    board = random_board(64, 64, seed=11)
+    got = np.asarray(fn(board, 4))
+    want = board
+    for _ in range(4):
+        want = vector_step(want, birth=birth, survive=survive)
+    np.testing.assert_array_equal(got, want, err_msg=rulename)
+
+
+def test_pallas_bit_kernel_general_rule_interpret():
+    fn = pallas_bit_step_n_fn(word_axis=0, interpret=True, rule=HIGHLIFE)
+    board = random_board(32, 32, seed=12)
+    got = np.asarray(fn(board, 3))
+    want = board
+    for _ in range(3):
+        want = vector_step(want, birth=(3, 6), survive=(2, 3))
+    np.testing.assert_array_equal(got, want)
 
 
 def test_engine_auto_fast_golden(tmp_path):
