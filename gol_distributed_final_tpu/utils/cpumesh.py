@@ -1,0 +1,66 @@
+"""The virtual-CPU-mesh recipe, shared by tests/conftest.py and the driver
+dry-run entry (__graft_entry__.dryrun_multichip).
+
+Multi-chip sharding is validated without multi-chip hardware by pointing JAX
+at an ``n``-device virtual CPU platform. The ambient environment pins JAX to
+the single real TPU chip (JAX_PLATFORMS=axon) and a sitecustomize module
+imports jax at interpreter start, so plain env vars are too late — the
+takeover must also go through ``jax.config``, which still applies as long as
+no devices have been queried yet. This module is import-safe before jax
+(nothing here imports jax at module level).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+
+def virtual_cpu_env(n_devices: int, base: dict | None = None) -> dict:
+    """Env overrides forcing an ``n_devices``-wide virtual CPU platform.
+
+    Scrubs any pre-existing ``--xla_force_host_platform_device_count`` from
+    XLA_FLAGS (taken from ``base`` or the current environment) first."""
+    env = os.environ if base is None else base
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+",
+        "",
+        env.get("XLA_FLAGS", ""),
+    )
+    return {
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip(),
+    }
+
+
+def force_virtual_cpu_devices(n_devices: int) -> bool:
+    """Repin THIS process's jax to ``n_devices`` virtual CPU devices.
+
+    Returns True on success. Fails (False) when jax's backends were already
+    initialised on another platform — callers needing isolation should spawn
+    a subprocess with ``virtual_cpu_env`` instead. Mutates os.environ only
+    on success (restored on failure)."""
+    saved = {k: os.environ.get(k) for k in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    os.environ.update(virtual_cpu_env(n_devices))
+    import jax
+
+    saved_platforms = jax.config.jax_platforms
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        devices = jax.devices()
+        ok = len(devices) >= n_devices and devices[0].platform == "cpu"
+    except Exception:
+        ok = False
+    if not ok:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        try:
+            jax.config.update("jax_platforms", saved_platforms)
+        except Exception:
+            pass  # backends already initialised; config change was inert
+    return ok

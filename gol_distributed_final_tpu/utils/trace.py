@@ -28,23 +28,29 @@ def trace(log_dir="trace_out"):
 
 class TurnsPerSecond:
     """Tiny throughput meter: feed completed-turn counts, read turns/sec
-    and cell-updates/sec (the driver metric, BASELINE.json)."""
+    and cell-updates/sec (the driver metric, BASELINE.json).
+
+    The clock is sampled once per ``update``, so the rate properties are
+    mutually consistent between updates (cell_updates_per_second ==
+    turns_per_second * cells_per_turn exactly, tests/test_aux.py)."""
 
     def __init__(self, cells_per_turn: int):
         self.cells_per_turn = cells_per_turn
         self._t0 = time.monotonic()
         self._turns = 0
+        self._elapsed = 0.0
 
     def update(self, turns_completed: int):
         self._turns = turns_completed
+        self._elapsed = time.monotonic() - self._t0
 
     @property
     def elapsed(self) -> float:
-        return time.monotonic() - self._t0
+        return self._elapsed
 
     @property
     def turns_per_second(self) -> float:
-        return self._turns / self.elapsed if self.elapsed else 0.0
+        return self._turns / self._elapsed if self._elapsed else 0.0
 
     @property
     def cell_updates_per_second(self) -> float:

@@ -6,38 +6,24 @@ on a virtual mesh exactly as the driver's dryrun does. Real-TPU behavior is
 covered by bench.py, not the test suite.
 """
 
-import os
 import pathlib
 import sys
 
-# force-override: the ambient environment pins JAX_PLATFORMS=axon (the real
-# TPU tunnel) and a sitecustomize module imports jax at interpreter start,
-# so plain env vars are too late — go through jax.config, which works as
-# long as no devices have been queried yet
-import re
-
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = re.sub(
-    r"--xla_force_host_platform_device_count=\d+",
-    "",
-    os.environ.get("XLA_FLAGS", ""),
-)
-os.environ["XLA_FLAGS"] = (
-    _flags + " --xla_force_host_platform_device_count=8"
-).strip()
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-
-assert jax.devices()[0].platform == "cpu", (
-    "tests must run on the virtual CPU mesh; jax devices were already "
-    f"initialised on {jax.devices()[0].platform} before conftest ran"
-)
-assert len(jax.devices()) == 8, "expected 8 virtual CPU devices"
-
 REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO_ROOT))
+
+# force-override: the ambient environment pins JAX_PLATFORMS=axon (the real
+# TPU tunnel) and a sitecustomize module imports jax at interpreter start,
+# so plain env vars are too late — utils/cpumesh.py goes through jax.config,
+# which works as long as no devices have been queried yet
+from gol_distributed_final_tpu.utils.cpumesh import (  # noqa: E402
+    force_virtual_cpu_devices,
+)
+
+assert force_virtual_cpu_devices(8), (
+    "tests must run on the 8-device virtual CPU mesh; jax devices were "
+    "already initialised on another platform before conftest ran"
+)
 
 import pytest  # noqa: E402
 
