@@ -1,20 +1,30 @@
 """Benchmark harness — run on real TPU hardware by the driver.
 
-Measures the headline metric from BASELINE.json: cell-updates/sec
-(turns x H x W / s) evolving the reference's 512x512 board, with
-bit-exactness gates against the committed alive-count goldens
-(check/alive/512x512.csv) at turn 1000 and turn 10000.
+Measures the headline metric from BASELINE.json — cell-updates/sec
+(turns x H x W / s) on the reference's 512x512 board — plus the other
+single-chip BASELINE configs:
 
-The timed path is the framework's fastest single-device data plane: the
-pallas VMEM bitboard kernel (ops/pallas_stencil.pallas_bit_step_n_fn —
-32 cells/int32 word, the whole evolution in one kernel launch). The
-remote-TPU tunnel adds a fixed ~0.1 s dispatch+transfer overhead per
-call, so throughput is computed from the MARGINAL cost between a 100k-turn
-and a 1.1M-turn run (overhead cancels; both runs are verified to return
-the period-2 steady state).
+  config 2: 128x128  — pallas VMEM bitboard kernel
+  config 3: 512x512  — pallas VMEM bitboard kernel (HEADLINE) + the
+            engine-driven number (Engine.run with the packed BitPlane,
+            chunked dispatches — what a real session achieves)
+  config 4: 4096x4096 — XLA bitboard (the packed board exceeds the
+            measured VMEM working-set budget, ops/pallas_stencil.fits_vmem,
+            so the gate routes to the HBM-resident XLA bitboard step)
+
+Parity gates: exact alive counts against check/alive/512x512.csv at turns
+1000 and 10000 plus the period-2 steady state; 128^2 against a numpy
+oracle at 1000 turns; 4096^2 bitboard against the independent roll-stencil
+implementation at 100 turns (on-device array equality).
+
+Methodology: the remote-TPU tunnel adds a fixed ~0.1 s dispatch+transfer
+overhead per call, so throughput is the MARGINAL cost between an n_lo- and
+an n_hi-turn run (overhead cancels). Each endpoint is min over REPS=5
+timed runs; the JSON reports median-based variance and the fixed-overhead
+residual so run-to-run spread is visible (VERDICT.md round-1 item 10).
 
 Prints exactly ONE JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}
 
 Baseline: the reference publishes no numbers (BASELINE.md). We use an
 explicit, documented estimate for its 8-worker distributed deployment:
@@ -24,73 +34,189 @@ board to every worker every turn (broker/broker.go:135-224) — giving
 """
 
 import json
+import statistics
 import sys
 import time
 
 BASELINE_CELL_UPDATES_PER_SEC = 50 * 512 * 512  # documented estimate, see above
 
-BOARD = 512
-GOLDEN = {1000: 6444, 10000: 5565}  # check/alive/512x512.csv
-STEADY = {0: 5565, 1: 5567}  # period-2 steady state beyond turn 10000
-N_LO, N_HI = 100_000, 1_100_000
-REPS = 3
+GOLDEN_512 = {1000: 6444, 10000: 5565}  # check/alive/512x512.csv
+STEADY_512 = {0: 5565, 1: 5567}  # period-2 steady state beyond turn 10000
+REPS = 5
+
+
+def oracle_step_n(board, n):
+    """Independent numpy reference (tests/oracle.py's vector_step, inlined
+    so bench.py stays standalone)."""
+    import numpy as np
+
+    b = (board != 0).astype(np.int32)
+    for _ in range(n):
+        counts = sum(
+            np.roll(np.roll(b, dy, 0), dx, 1)
+            for dy in (-1, 0, 1)
+            for dx in (-1, 0, 1)
+            if (dy, dx) != (0, 0)
+        )
+        b = ((counts == 3) | ((b == 1) & (counts == 2))).astype(np.int32)
+    return (b * 255).astype(np.uint8)
+
+
+def marginal(time_fn, n_lo, n_hi):
+    """Per-run-unit marginal cost between n_lo and n_hi, with variance.
+
+    Returns (per_turn_seconds, details): endpoints are min over REPS; the
+    details dict records min/median/spread per endpoint and the fixed
+    overhead implied by the linear fit."""
+
+    def sample(n):
+        times = []
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            time_fn(n)
+            times.append(time.perf_counter() - t0)
+        return times
+
+    lo, hi = sample(n_lo), sample(n_hi)
+    per_turn = (min(hi) - min(lo)) / (n_hi - n_lo)
+    details = {
+        "n_lo": n_lo,
+        "n_hi": n_hi,
+        "reps": REPS,
+        "t_lo_min_s": round(min(lo), 4),
+        "t_lo_median_s": round(statistics.median(lo), 4),
+        "t_hi_min_s": round(min(hi), 4),
+        "t_hi_median_s": round(statistics.median(hi), 4),
+        "fixed_overhead_s": round(min(lo) - n_lo * per_turn, 4),
+        "per_turn_us": round(per_turn * 1e6, 5),
+        "per_turn_us_median_fit": round(
+            (statistics.median(hi) - statistics.median(lo)) / (n_hi - n_lo) * 1e6,
+            5,
+        ),
+    }
+    return per_turn, details
 
 
 def main() -> int:
     import numpy as np
 
     import jax
+    import jax.numpy as jnp
 
     from gol_distributed_final_tpu.io.pgm import read_pgm
+    from gol_distributed_final_tpu.models import CONWAY
     from gol_distributed_final_tpu.ops import bitpack
-    from gol_distributed_final_tpu.ops.pallas_stencil import _bit_compiled
+    from gol_distributed_final_tpu.ops.pallas_stencil import _bit_compiled, fits_vmem
+    from gol_distributed_final_tpu.ops.plane import BitPlane
 
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
     print(f"bench device: {dev}", file=sys.stderr)
+    extra = {}
 
-    board = read_pgm(f"images/{BOARD}x{BOARD}.pgm")
+    # ---- config 3 (headline): 512^2, pallas VMEM bitboard ----------------
+    board = read_pgm("images/512x512.pgm")
     word_axis = 0  # rows packed: [H/32, W], lanes stay W wide
     packed = jax.device_put(bitpack.pack(board, word_axis))
+    assert fits_vmem(packed.shape, itemsize=4)
 
     def evolve(n):
+        # np.asarray forces a full device sync (block_until_ready does not
+        # reliably wait under the remote tunnel)
         return np.asarray(_bit_compiled(n, word_axis, not on_tpu)(packed))
 
-    # correctness gates: exact alive counts at the golden checkpoints
-    for n, want in GOLDEN.items():
+    for n, want in GOLDEN_512.items():
         alive = int(np.count_nonzero(bitpack.unpack(evolve(n), word_axis)))
         if alive != want:
-            print(f"PARITY FAILURE at turn {n}: {alive} != {want}", file=sys.stderr)
+            print(f"PARITY FAILURE 512^2 turn {n}: {alive} != {want}", file=sys.stderr)
             return 1
-    print("parity gates passed (turns 1000, 10000)", file=sys.stderr)
+    print("parity 512^2 ok (turns 1000, 10000)", file=sys.stderr)
 
-    def best_time(n):
-        evolve(n)  # warm/compile
-        best = float("inf")
-        for _ in range(REPS):
-            t0 = time.perf_counter()
-            out = evolve(n)  # np.asarray forces full device sync
-            best = min(best, time.perf_counter() - t0)
-        alive = int(np.count_nonzero(bitpack.unpack(out, word_axis)))
-        if alive != STEADY[n % 2]:
-            raise AssertionError(f"steady-state violation at {n}: {alive}")
-        return best
+    n_lo, n_hi = 100_000, 1_100_000
+    for n in (n_lo, n_hi):  # warm/compile + steady-state gate
+        alive = int(np.count_nonzero(bitpack.unpack(evolve(n), word_axis)))
+        if alive != STEADY_512[n % 2]:
+            print(f"STEADY-STATE FAILURE at {n}: {alive}", file=sys.stderr)
+            return 1
+    per_turn, det = marginal(evolve, n_lo, n_hi)
+    headline = 512 * 512 / per_turn
+    extra["c3_512_pallas_bitboard"] = dict(det, cell_updates_per_s=round(headline))
 
-    t_lo, t_hi = best_time(N_LO), best_time(N_HI)
-    per_turn = (t_hi - t_lo) / (N_HI - N_LO)
-    value = BOARD * BOARD / per_turn
-    print(
-        f"fixed overhead ~{t_lo - N_LO * per_turn:.3f}s, "
-        f"{per_turn * 1e6:.3f} us/turn marginal",
-        file=sys.stderr,
+    # ---- config 3, engine-driven: what Engine.run actually achieves ------
+    from gol_distributed_final_tpu.engine.engine import Engine, EngineConfig
+    from gol_distributed_final_tpu.params import Params
+
+    cfg = EngineConfig(min_chunk=1 << 20, max_chunk=1 << 20, target_dispatch_seconds=8.0)
+
+    def engine_run(n):
+        r = Engine(cfg).run(
+            Params(turns=n, image_width=512, image_height=512), board
+        )
+        return r
+
+    alive = len(engine_run(10_000).alive)
+    if alive != GOLDEN_512[10_000]:
+        print(f"ENGINE PARITY FAILURE: {alive}", file=sys.stderr)
+        return 1
+    engine_run(n_lo), engine_run(n_hi)  # warm both endpoint shapes
+    eng_per_turn, eng_det = marginal(engine_run, n_lo, n_hi)
+    extra["c3_512_engine_driven"] = dict(
+        eng_det,
+        cell_updates_per_s=round(512 * 512 / eng_per_turn),
+        ratio_vs_raw_kernel=round(eng_per_turn / per_turn, 2),
     )
+
+    # ---- config 2: 128^2 -------------------------------------------------
+    b128 = read_pgm("images/128x128.pgm")
+    want128 = int(np.count_nonzero(oracle_step_n(b128, 1000)))
+    p128 = jax.device_put(bitpack.pack(b128, word_axis))
+
+    def evolve128(n):
+        return np.asarray(_bit_compiled(n, word_axis, not on_tpu)(p128))
+
+    alive = int(np.count_nonzero(bitpack.unpack(evolve128(1000), word_axis)))
+    if alive != want128:
+        print(f"PARITY FAILURE 128^2: {alive} != {want128}", file=sys.stderr)
+        return 1
+    print("parity 128^2 ok (1000 turns vs numpy oracle)", file=sys.stderr)
+    evolve128(n_lo), evolve128(n_hi)
+    pt128, det128 = marginal(evolve128, n_lo, n_hi)
+    extra["c2_128_pallas_bitboard"] = dict(
+        det128, cell_updates_per_s=round(128 * 128 / pt128)
+    )
+
+    # ---- config 4: 4096^2 (XLA bitboard beyond the VMEM gate) ------------
+    rng = np.random.default_rng(0)
+    b4k = np.where(rng.random((4096, 4096)) < 0.3, 255, 0).astype(np.uint8)
+    plane = BitPlane(CONWAY, word_axis)
+    state = plane.encode(b4k)
+    assert not fits_vmem(state.shape, itemsize=4), "4096^2 must take the XLA path"
+    # cross-implementation parity: independent roll stencil, 100 turns
+    want4k = CONWAY.step_n(jnp.asarray(b4k), 100)
+    got4k = plane.decode(plane.step_n(state, 100))
+    if not np.array_equal(got4k, np.asarray(want4k)):
+        print("PARITY FAILURE 4096^2 vs roll stencil", file=sys.stderr)
+        return 1
+    print("parity 4096^2 ok (100 turns vs roll stencil)", file=sys.stderr)
+
+    def evolve4k(n):
+        return np.asarray(plane.step_n(state, n))
+
+    n4_lo, n4_hi = 2_000, 12_000  # config-4 scale: 10k turns
+    evolve4k(n4_lo), evolve4k(n4_hi)
+    pt4k, det4k = marginal(evolve4k, n4_lo, n4_hi)
+    extra["c4_4096_xla_bitboard"] = dict(
+        det4k, cell_updates_per_s=round(4096 * 4096 / pt4k)
+    )
+
     print(
         json.dumps(
             {
                 "metric": "cell-updates/sec (512x512 Conway, marginal over 1M turns, single chip)",
-                "value": value,
+                "value": headline,
                 "unit": "cell-updates/s",
-                "vs_baseline": value / BASELINE_CELL_UPDATES_PER_SEC,
+                "vs_baseline": headline / BASELINE_CELL_UPDATES_PER_SEC,
+                "extra": extra,
             }
         )
     )

@@ -58,15 +58,22 @@ class RunResult(NamedTuple):
 @dataclasses.dataclass
 class EngineConfig:
     rule: LifeRule = CONWAY
-    # chunking: double from 1 up to max_chunk, but stop growing once a
-    # dispatch exceeds target_dispatch_seconds (keeps control latency low)
+    # chunking: double from min_chunk up to max_chunk, but stop growing once
+    # a dispatch exceeds target_dispatch_seconds (keeps control latency low);
+    # long headless runs can raise min_chunk to skip the warm-up doublings
+    min_chunk: int = 1
     max_chunk: int = 4096
     target_dispatch_seconds: float = 0.25
     # optional override: a board -> board step (e.g. a sharded halo step from
     # parallel/halo.py, or the pallas kernel); must preserve dtype/shape
     step_n_fn: Optional[Callable] = None  # (board, n) -> board
+    # optional override: a full data plane (ops/plane.py interface) — e.g. a
+    # mesh-sharded bitboard (parallel/bit_halo.ShardedBitPlane); the board
+    # stays in the plane's representation across chunk dispatches
+    plane: Optional[object] = None
     # pick the fastest correct data plane automatically (ops/auto.py):
-    # on TPU the pallas VMEM bitboard kernel for Conway-compatible boards
+    # the bitboard plane (pallas VMEM kernel under its VMEM gate) for
+    # 32-divisible boards
     auto_fast: bool = True
 
 
@@ -77,7 +84,11 @@ class Engine:
         self.config = config or EngineConfig()
         self._lock = threading.Lock()
         self._control = threading.Condition(self._lock)
-        self._board_dev = None  # device array, owned by the run loop
+        # the device-resident board in its plane's representation (e.g. a
+        # packed bitboard), owned by the run loop; kept after a run ends so
+        # Retrieve keeps serving the final snapshot (the cWorld analogue)
+        self._state = None
+        self._plane = None
         self._world_host: np.ndarray | None = None  # last synced host copy
         self._host_dirty = False
         self._turn = 0
@@ -86,20 +97,35 @@ class Engine:
         self._quit = False
         self._super_quit = False
         self._running = False
-        self._active_step_fn = None  # per-run override, set by run()
 
     # -- compute ----------------------------------------------------------
 
-    def _step_n(self, board, n: int):
-        fn = self._active_step_fn or self.config.step_n_fn
-        if fn is not None:
-            return fn(board, n)
-        return self.config.rule.step_n(board, n)
+    def _choose_plane(self, world_shape, step_n_fn, plane, emit_flips):
+        """Per-run plane selection: explicit plane > explicit step fn >
+        config plane > config step fn > auto bitboard > byte stencil."""
+        from ..ops.plane import BytePlane
+
+        rule = self.config.rule
+        if plane is not None:
+            return plane
+        if step_n_fn is not None:
+            return BytePlane(rule, step_n_fn)
+        if self.config.plane is not None:
+            return self.config.plane
+        if self.config.step_n_fn is not None:
+            return BytePlane(rule, self.config.step_n_fn)
+        if self.config.auto_fast and not emit_flips:
+            from ..ops.auto import auto_plane
+
+            fast = auto_plane(rule, world_shape)
+            if fast is not None:
+                return fast
+        return BytePlane(rule)
 
     def _sync_host(self):
-        """Refresh the host snapshot from the device board (under lock)."""
-        if self._host_dirty and self._board_dev is not None:
-            self._world_host = np.asarray(self._board_dev)
+        """Refresh the host snapshot from the device state (under lock)."""
+        if self._host_dirty and self._state is not None:
+            self._world_host = self._plane.decode(self._state)
             self._host_dirty = False
 
     # -- Operations.Run (broker/broker.go:62-234) -------------------------
@@ -112,6 +138,7 @@ class Engine:
         emit: Optional[Callable] = None,
         emit_flips: bool = False,
         step_n_fn: Optional[Callable] = None,
+        plane=None,
         initial_turn: int = 0,
     ) -> RunResult:
         """Blocking: evolve ``world`` for ``params.turns`` turns (or until
@@ -124,8 +151,6 @@ class Engine:
         (gol/event.go:50-60) — including the initial flips for cells alive
         in the loaded image.
         """
-        import jax.numpy as jnp
-
         # defensive copy: the caller may reuse its buffer, and we hand this
         # array out via retrieve()/emit_flips diffs
         world = np.array(world, np.uint8, copy=True)
@@ -134,17 +159,13 @@ class Engine:
             if self._running:
                 raise RuntimeError("engine is already running")
             self._running = True
-            # per-run step override (e.g. a geometry-specific mesh step):
-            # set only after the already-running check, so a rejected
-            # concurrent run can't clobber the active run's step function
-            if step_n_fn is None and self.config.step_n_fn is None and (
-                self.config.auto_fast and not emit_flips
-            ):
-                from ..ops.auto import auto_step_n_fn
-
-                step_n_fn = auto_step_n_fn(self.config.rule, world.shape)
-            self._active_step_fn = step_n_fn
-            self._board_dev = jnp.asarray(world)
+            # per-run plane selection happens only after the already-running
+            # check, so a rejected concurrent run can't clobber the active
+            # run's representation
+            self._plane = self._choose_plane(
+                world.shape, step_n_fn, plane, emit_flips
+            )
+            self._state = self._plane.encode(world)
             self._world_host = world
             self._host_dirty = False
             # 0 for a fresh run (the reference's reset-on-Run semantics,
@@ -159,7 +180,7 @@ class Engine:
             if emit_flips and emit is not None:
                 for c in alive_cells(world):
                     emit(CellFlipped(0, c))
-            chunk = 1
+            chunk = max(1, min(self.config.min_chunk, self.config.max_chunk))
             while True:
                 with self._lock:
                     while self._paused and not self._quit:
@@ -172,16 +193,17 @@ class Engine:
                     n = min(chunk, params.turns - self._turn)
                     if emit_flips:
                         n = 1
-                    board = self._board_dev
+                    state = self._state
+                    active_plane = self._plane
 
                 t0 = time.monotonic()
-                new_board = self._step_n(board, n)
-                new_board.block_until_ready()
+                new_state = active_plane.step_n(state, n)
+                new_state.block_until_ready()
                 elapsed = time.monotonic() - t0
 
                 with self._lock:
                     prev_host = self._world_host if emit_flips else None
-                    self._board_dev = new_board
+                    self._state = new_state
                     self._host_dirty = True
                     self._turn += n
                     turn_now = self._turn
@@ -202,7 +224,7 @@ class Engine:
                     and chunk < self.config.max_chunk
                     and elapsed < self.config.target_dispatch_seconds
                 ):
-                    chunk *= 2
+                    chunk = min(chunk * 2, self.config.max_chunk)
 
             with self._lock:
                 self._sync_host()
@@ -214,7 +236,7 @@ class Engine:
                 self._running = False
                 self._paused = False
                 self._quit = False  # consumed; a reattached run starts fresh
-                self._active_step_fn = None
+                # _plane/_state stay: Retrieve keeps serving the final board
                 self._control.notify_all()
 
     # -- control plane (broker/broker.go:236-277) -------------------------
@@ -261,22 +283,21 @@ class Engine:
         (broker/broker.go:256-277).
 
         With ``include_world=False`` (the 2-second ticker's path) the count
-        is a jitted device-side reduction — 4 bytes cross the device
-        boundary instead of the whole board. The reference re-ships the full
-        world on every Retrieve (broker/broker.go:262-270); the TPU-first
-        control plane does not."""
-        from ..ops import alive_count
-
+        is a device-side reduction in the plane's own representation (a
+        popcount for the bitboard) — a few bytes cross the device boundary
+        instead of the whole board. The reference re-ships the full world on
+        every Retrieve (broker/broker.go:262-270); the TPU-first control
+        plane does not."""
         with self._lock:
             turn = self._turn
             if include_world:
                 self._sync_host()
                 world = self._world_host
             else:
-                board_dev = self._board_dev
+                state, active_plane = self._state, self._plane
                 world = None
         if not include_world:
-            count = int(alive_count(board_dev)) if board_dev is not None else 0
+            count = active_plane.alive_count(state) if state is not None else 0
             return Snapshot(world, turn, count)
         if world is None:
             world = np.zeros((0, 0), np.uint8)

@@ -17,14 +17,41 @@ from typing import Callable, Optional
 import jax
 
 
-def auto_step_n_fn(rule, shape: tuple[int, int]) -> Optional[Callable]:
-    """An engine-compatible ``(board_uint8, n) -> board_uint8`` or None."""
+def choose_word_axis(shape: tuple[int, int]) -> Optional[int]:
+    """The single-device packed-layout policy: pack rows when H divides by
+    32 ([H/32, W] keeps the lane dimension W wide — fastest on TPU), else
+    columns, else None (only the roll stencil applies)."""
     h, w = shape
     if h % 32 == 0:
-        word_axis = 0  # rows packed: [H/32, W] keeps lanes wide on TPU
-    elif w % 32 == 0:
-        word_axis = 1
-    else:
+        return 0
+    if w % 32 == 0:
+        return 1
+    return None
+
+
+def auto_plane(rule, shape: tuple[int, int]):
+    """The fastest correct single-device data plane (ops/plane.py interface)
+    for this rule/geometry, or None if only the roll stencil applies.
+
+    Unlike the legacy ``auto_step_n_fn`` (which pack/unpacks per call), a
+    plane keeps the board bit-packed across chunk dispatches — the engine's
+    hot loop does no representation changes at all."""
+    word_axis = choose_word_axis(shape)
+    if word_axis is None:
+        return None
+
+    from .plane import BitPlane
+
+    return BitPlane(rule, word_axis)
+
+
+def auto_step_n_fn(rule, shape: tuple[int, int]) -> Optional[Callable]:
+    """An engine-compatible ``(board_uint8, n) -> board_uint8`` or None.
+
+    Legacy per-call pack/evolve/unpack form of ``auto_plane`` — same layout
+    policy, kept for callers that want a plain step function."""
+    word_axis = choose_word_axis(shape)
+    if word_axis is None:
         return None
 
     if jax.devices()[0].platform == "tpu":

@@ -77,6 +77,59 @@ def unpack(packed: np.ndarray | jax.Array, word_axis: int = 0) -> np.ndarray:
     return (board * 255).astype(np.uint8)
 
 
+@functools.partial(jax.jit, static_argnums=(1,))
+def pack_device(board, word_axis: int = 0):
+    """On-device jnp ``pack``: uint8 {0,255} [H, W] -> int32 bitboard.
+
+    Runs under jit (and inside pjit with a sharded board), so the engine's
+    hot path never round-trips through host numpy (the round-1 pack/unpack
+    were numpy-only, costing a D2H+H2D per chunk dispatch)."""
+    bits = (board != 0).astype(jnp.uint32)
+    h, w = board.shape
+    if word_axis == 1:
+        if w % WORD:
+            raise ValueError(f"width {w} not divisible by {WORD}")
+        words = bits.reshape(h, w // WORD, WORD)
+        axis = 2
+        shifts = jnp.arange(WORD, dtype=jnp.uint32)
+    else:
+        if h % WORD:
+            raise ValueError(f"height {h} not divisible by {WORD}")
+        words = bits.reshape(h // WORD, WORD, w)
+        axis = 1
+        shifts = jnp.arange(WORD, dtype=jnp.uint32)[:, None]
+    packed = jnp.sum(words << shifts, axis=axis, dtype=jnp.uint32)
+    return lax.bitcast_convert_type(packed, jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def unpack_device(packed, word_axis: int = 0):
+    """On-device jnp ``unpack``: int32 bitboard -> uint8 {0,255} [H, W]."""
+    words = lax.bitcast_convert_type(packed, jnp.uint32)
+    shifts = jnp.arange(WORD, dtype=jnp.uint32)
+    if word_axis == 1:
+        bits = (words[:, :, None] >> shifts) & 1
+        board = bits.reshape(words.shape[0], -1)
+    else:
+        bits = (words[:, None, :] >> shifts[:, None]) & 1
+        board = bits.reshape(-1, words.shape[1])
+    return (board * 255).astype(jnp.uint8)
+
+
+@jax.jit
+def _row_popcounts(packed):
+    # int32 row sums are safe (a row covers <= 32 * W cells); the final
+    # accumulation happens on host in int64 so boards >= 2^31 cells can't
+    # overflow the count
+    return jnp.sum(lax.population_count(packed), axis=1)
+
+
+def alive_count_packed(packed) -> int:
+    """Alive cells of a bitboard: a device-side popcount reduction — no
+    unpack, ~4*H bytes cross the device boundary instead of H*W."""
+    return int(np.sum(np.asarray(_row_popcounts(packed)), dtype=np.int64))
+
+
 def _default_rot1(a, shift: int, axis: int):
     return jnp.roll(a, shift, axis=axis)
 
@@ -187,12 +240,13 @@ def bit_step_n(
 
 def packed_step_n_fn(word_axis: int = 0, rule=None):
     """Engine-compatible ``(board_uint8, n) -> board_uint8``: pack, evolve
-    on the bitboard, unpack — the fast life-like data plane on any backend."""
+    on the bitboard, unpack — all on-device, no host round-trips."""
     birth = rule.birth_mask if rule else CONWAY_BIRTH_MASK
     survive = rule.survive_mask if rule else CONWAY_SURVIVE_MASK
 
     def step_n(board, n):
-        out = bit_step_n(pack(board, word_axis), int(n), word_axis, birth, survive)
-        return jnp.asarray(unpack(out, word_axis))
+        packed = pack_device(jnp.asarray(board), word_axis)
+        out = bit_step_n(packed, int(n), word_axis, birth, survive)
+        return unpack_device(out, word_axis)
 
     return step_n

@@ -27,12 +27,28 @@ from jax import lax
 
 from .stencil import apply_rule
 
-# leave generous headroom for double buffering + compiler temporaries
-VMEM_BOARD_LIMIT_BYTES = 4 * 1024 * 1024
+# Physical VMEM is ~16 MiB/core (v4/v5e). The gates below are BYTE budgets
+# on the kernel's int32 WORKING SET, not element counts (the round-1 gate
+# compared elements against bytes and over-admitted 4x-16x — VERDICT.md).
+VMEM_BYTES = 16 * 1024 * 1024
+
+# The n-turn fori_loop keeps ~2 int32 boards live plus Mosaic temporaries
+# for the fused shift/add chain. Measured on a real v5e chip (2026-07):
+# the bitboard kernel compiles at packed <= 1.5 MiB and fails at 2 MiB,
+# i.e. the compiler's working set is ~10x the packed array. The byte
+# kernel upcasts the uint8 board to int32, so its working set is ~10x
+# of 4*H*W.
+_WORKING_SET_FACTOR = 10
 
 
-def fits_vmem(shape: tuple[int, int]) -> bool:
-    return shape[0] * shape[1] <= VMEM_BOARD_LIMIT_BYTES
+def fits_vmem(shape: tuple[int, int], itemsize: int) -> bool:
+    """True if an n-turn VMEM-resident kernel over an array of ``shape`` x
+    ``itemsize`` bytes fits the measured working-set budget.
+
+    For the byte kernel pass itemsize=4 (the board is carried as int32
+    inside the loop); for the bitboard kernel pass the packed dtype's
+    itemsize (4)."""
+    return shape[0] * shape[1] * itemsize * _WORKING_SET_FACTOR <= VMEM_BYTES
 
 
 def _rot1(a, shift: int, axis: int, *, interpret: bool = False):
@@ -191,7 +207,7 @@ def pallas_bit_step_n_fn(
 
     Engine-compatible ``(board_uint8, n) -> board_uint8``.
     """
-    from .bitpack import bit_step_n, pack, unpack
+    from .bitpack import bit_step_n, pack_device, unpack_device
     from .stencil import CONWAY_BIRTH_MASK, CONWAY_SURVIVE_MASK
 
     birth = rule.birth_mask if rule else CONWAY_BIRTH_MASK
@@ -201,12 +217,12 @@ def pallas_bit_step_n_fn(
 
     def step_n(board, n):
         n = int(n)
-        packed = pack(board, word_axis)
-        if not fits_vmem(packed.shape):  # int32 words: limit is generous
+        packed = pack_device(jnp.asarray(board), word_axis)
+        if not fits_vmem(packed.shape, itemsize=4):
             out = bit_step_n(packed, n, word_axis, birth, survive)
         else:
             out = _bit_compiled(n, word_axis, interpret, birth, survive)(packed)
-        return jnp.asarray(unpack(out, word_axis))
+        return unpack_device(out, word_axis)
 
     return step_n
 
@@ -234,7 +250,7 @@ def pallas_step_n_fn(
 
     def step_n(board, n):
         n = int(n)
-        if not fits_vmem(board.shape):
+        if not fits_vmem(board.shape, itemsize=4):  # carried as int32 in-loop
             return fallback(board, n)
         fn = _compiled(n, rule.birth_mask, rule.survive_mask, interpret)
         return fn(board)

@@ -1,0 +1,106 @@
+"""Data planes — the engine's pluggable on-device board representation.
+
+The reference's compute state is one concrete thing: a ``[][]byte`` world
+re-shipped to workers every turn (broker/broker.go:135-224). Here the engine
+holds an opaque device-resident *state* and talks to it through a small
+interface, so the fast representations (the int32 bitboard, a mesh-sharded
+bitboard) stay packed ACROSS chunk dispatches — encode once at Run start,
+decode only for Retrieve/final. Round 1 repacked from host numpy on every
+chunk (a 16 MiB+ D2H/H2D per dispatch at 4096^2, VERDICT.md); with a plane
+the hot loop is pure device work.
+
+Interface (duck-typed):
+    encode(board_uint8) -> state      host/device uint8 [H, W] -> device state
+    step_n(state, n) -> state         n turns, one or few dispatches
+    decode(state) -> np.uint8 [H, W]  full host board (Retrieve/final only)
+    alive_count(state) -> int         device-side reduction, tiny transfer
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..models import CONWAY, LifeRule
+
+
+class BytePlane:
+    """The identity representation: a device uint8 {0,255} board.
+
+    Wraps any ``(board, n) -> board`` step (the roll stencil, a shard_map
+    halo step) into the plane interface."""
+
+    def __init__(
+        self,
+        rule: LifeRule = CONWAY,
+        step_n_fn: Optional[Callable] = None,
+    ):
+        self.rule = rule
+        self._step_n = step_n_fn or rule.step_n
+
+    def encode(self, board):
+        import jax.numpy as jnp
+
+        return jnp.asarray(board)
+
+    def step_n(self, state, n: int):
+        return self._step_n(state, n)
+
+    def decode(self, state) -> np.ndarray:
+        return np.asarray(state)
+
+    def alive_count(self, state) -> int:
+        from .reduce import alive_count
+
+        return int(alive_count(state))
+
+
+class BitPlane:
+    """The int32 bitboard representation: 32 cells/word, state stays packed
+    across chunks. ``step_n`` routes to the pallas VMEM kernel when the
+    packed board fits the measured VMEM working-set budget, else the XLA
+    bitboard step; ``alive_count`` is a popcount — no unpack."""
+
+    def __init__(
+        self,
+        rule: LifeRule = CONWAY,
+        word_axis: int = 0,
+        interpret: Optional[bool] = None,
+    ):
+        import jax
+
+        self.rule = rule
+        self.word_axis = word_axis
+        self.interpret = (
+            jax.devices()[0].platform != "tpu" if interpret is None else interpret
+        )
+
+    def encode(self, board):
+        import jax.numpy as jnp
+
+        from .bitpack import pack_device
+
+        return pack_device(jnp.asarray(board), self.word_axis)
+
+    def step_n(self, state, n: int):
+        from .bitpack import bit_step_n
+        from .pallas_stencil import _bit_compiled, fits_vmem
+
+        n = int(n)
+        birth, survive = self.rule.birth_mask, self.rule.survive_mask
+        if fits_vmem(state.shape, itemsize=4):
+            return _bit_compiled(n, self.word_axis, self.interpret, birth, survive)(
+                state
+            )
+        return bit_step_n(state, n, self.word_axis, birth, survive)
+
+    def decode(self, state) -> np.ndarray:
+        from .bitpack import unpack_device
+
+        return np.asarray(unpack_device(state, self.word_axis))
+
+    def alive_count(self, state) -> int:
+        from .bitpack import alive_count_packed
+
+        return alive_count_packed(state)

@@ -1,0 +1,160 @@
+"""The fast data plane x the mesh: bit-packed halo-exchange steps.
+
+Round 1's mesh path ran the byte-per-cell roll stencil inside shard_map
+(parallel/halo.py) — ~12x slower per device than the bitboard kernels the
+single-chip bench used. Here ``bit_step`` (ops/bitpack.py: 32 cells/int32
+word, carry-save adder trees) runs INSIDE shard_map, so per-device mesh
+throughput matches the single-chip bitboard path.
+
+Halo mechanics: the packed array is 2-D (one spatial axis packed into bits,
+the other left as elements), sharded P('rows', 'cols'). ``bit_step``'s
+output word (i, j) depends only on input words (i±1, j±1), regardless of
+which axis is packed — bit carries cross word boundaries through the
+ADJACENT ELEMENT along the packed axis, and the 3x3 element neighbourhood
+covers the rest. So the classic two-phase thickness-1 halo exchange of the
+byte plane (rows first, then columns of the extended block — corners ride
+the second phase) works verbatim on packed words: per turn each device
+ppermutes one word-row and one word-column — O(perimeter/32) traffic on the
+packed axis — then computes ``bit_step`` on the extended block and keeps
+the interior. ``bit_step``'s cyclic rotates only contaminate the extended
+block's outer ring, which is exactly what gets sliced away; with a
+single-device axis the "halo" is the local wrap slice and the same slicing
+yields torus semantics.
+
+Reference anchor: the one kernel running on every worker
+(worker/worker.go:15-70), re-founded so the strip a worker owns never
+leaves its device (vs broker/broker.go:135-224's full-board reships).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import numpy as np
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models import CONWAY, LifeRule
+from ..ops.bitpack import WORD, bit_step, pack_device, unpack_device
+from .halo import _exchange
+from .mesh import COLS, ROWS
+
+
+def choose_bit_layout(
+    board_shape: tuple[int, int], mesh_shape: tuple[int, int]
+) -> Optional[int]:
+    """Pick a ``word_axis`` whose packed array divides over the mesh.
+
+    Prefers packing rows (word_axis=0, packed [H/32, W]) — the lane
+    dimension stays W wide, ~6x faster on TPU — falling back to packing
+    columns, then None (caller uses the byte plane)."""
+    h, w = board_shape
+    nrows, ncols = mesh_shape
+    if h % (WORD * nrows) == 0 and w % ncols == 0:
+        return 0
+    if h % nrows == 0 and w % (WORD * ncols) == 0:
+        return 1
+    return None
+
+
+def _local_bit_step(block, *, rule: LifeRule, mesh_shape, word_axis: int):
+    nrows, ncols = mesh_shape
+    ext = _exchange(block, ROWS, nrows, dim=0)  # (h+2, w)
+    ext = _exchange(ext, COLS, ncols, dim=1)  # (h+2, w+2), corners ride phase 2
+    out = bit_step(
+        ext,
+        word_axis,
+        birth_mask=rule.birth_mask,
+        survive_mask=rule.survive_mask,
+    )
+    return out[1:-1, 1:-1]
+
+
+def packed_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(ROWS, COLS))
+
+
+def sharded_bit_step_n_fn(
+    mesh: Mesh, rule: LifeRule = CONWAY, word_axis: int = 0
+) -> Callable:
+    """A jitted ``(packed, n) -> packed`` over a P('rows','cols')-sharded
+    int32 bitboard: n turns in ONE dispatch, the fori_loop (halo ppermutes
+    included) inside shard_map."""
+    mesh_shape = (mesh.shape[ROWS], mesh.shape[COLS])
+    local = functools.partial(
+        _local_bit_step, rule=rule, mesh_shape=mesh_shape, word_axis=word_axis
+    )
+    sharding = packed_sharding(mesh)
+
+    @functools.lru_cache(maxsize=None)
+    def _compiled(n: int):
+        def local_n(block):
+            return lax.fori_loop(0, n, lambda _, b: local(b), block)
+
+        sharded = jax.shard_map(
+            local_n, mesh=mesh, in_specs=P(ROWS, COLS), out_specs=P(ROWS, COLS)
+        )
+        return jax.jit(sharded, in_shardings=sharding, out_shardings=sharding)
+
+    def step_n(packed, n):
+        return _compiled(int(n))(packed)
+
+    return step_n
+
+
+class ShardedBitPlane:
+    """Engine data plane (ops/plane.py interface): a mesh-sharded bitboard.
+
+    State is the packed int32 array sharded over the mesh; it stays packed
+    and sharded across every chunk dispatch. encode/decode are jitted
+    device-side pack/unpack placed on the mesh; alive_count is a sharded
+    popcount reduction."""
+
+    def __init__(self, mesh: Mesh, rule: LifeRule = CONWAY, word_axis: int = 0):
+        self.mesh = mesh
+        self.rule = rule
+        self.word_axis = word_axis
+        self._step_n = sharded_bit_step_n_fn(mesh, rule, word_axis)
+        packed_shd = packed_sharding(mesh)
+        board_shd = NamedSharding(mesh, P(ROWS, COLS))
+        self._encode = jax.jit(
+            functools.partial(pack_device, word_axis=word_axis),
+            in_shardings=board_shd,
+            out_shardings=packed_shd,
+        )
+        self._decode = jax.jit(
+            functools.partial(unpack_device, word_axis=word_axis),
+            in_shardings=packed_shd,
+            out_shardings=board_shd,
+        )
+
+    def encode(self, board):
+        import jax.numpy as jnp
+
+        return self._encode(jnp.asarray(board))
+
+    def step_n(self, state, n: int):
+        return self._step_n(state, n)
+
+    def decode(self, state) -> np.ndarray:
+        return np.asarray(self._decode(state))
+
+    def alive_count(self, state) -> int:
+        from ..ops.bitpack import alive_count_packed
+
+        return alive_count_packed(state)
+
+
+def make_bit_plane(
+    mesh: Mesh, board_shape: tuple[int, int], rule: LifeRule = CONWAY
+) -> Optional[ShardedBitPlane]:
+    """A ShardedBitPlane for this board/mesh if a packed layout divides,
+    else None (caller falls back to the byte halo plane)."""
+    mesh_shape = (mesh.shape[ROWS], mesh.shape[COLS])
+    word_axis = choose_bit_layout(board_shape, mesh_shape)
+    if word_axis is None:
+        return None
+    return ShardedBitPlane(mesh, rule, word_axis)

@@ -42,26 +42,35 @@ class TpuBackend:
     def __init__(self, use_mesh: bool = True):
         self._use_mesh = use_mesh
         self.engine = Engine()
-        self._step_fns: dict = {}
+        self._planes: dict = {}
 
-    def _step_fn_for(self, height: int, width: int):
-        # mesh step if the local devices divide the board; else single-device
+    def _plane_for(self, height: int, width: int):
+        """A mesh data plane if the local devices divide the board — the
+        bit-packed halo plane when a packed layout divides too (the fast
+        kernel on every 'worker', parallel/bit_halo.py), else the byte halo
+        plane; None for a single device (the engine auto-picks)."""
         key = (height, width)
-        if key not in self._step_fns:
-            fn = None
+        if key not in self._planes:
+            plane = None
             if self._use_mesh:
                 import jax
 
+                from ..ops.plane import BytePlane
                 from ..parallel import make_engine_step, make_mesh
+                from ..parallel.bit_halo import make_bit_plane
 
                 if len(jax.devices()) > 1:
                     try:
                         mesh = make_mesh(height=height, width=width)
-                        fn = make_engine_step(mesh)
+                        plane = make_bit_plane(mesh, (height, width))
+                        if plane is None:
+                            plane = BytePlane(
+                                self.engine.config.rule, make_engine_step(mesh)
+                            )
                     except ValueError:
                         pass  # indivisible board: single-device engine
-            self._step_fns[key] = fn
-        return self._step_fns[key]
+            self._planes[key] = plane
+        return self._planes[key]
 
     def run(self, req: Request) -> RunResult:
         from ..params import Params
@@ -72,9 +81,9 @@ class TpuBackend:
             image_width=req.image_width,
             image_height=req.image_height,
         )
-        step_fn = self._step_fn_for(req.image_height, req.image_width)
+        plane = self._plane_for(req.image_height, req.image_width)
         return self.engine.run(
-            params, req.world, step_n_fn=step_fn, initial_turn=req.initial_turn
+            params, req.world, plane=plane, initial_turn=req.initial_turn
         )
 
     def pause(self):
