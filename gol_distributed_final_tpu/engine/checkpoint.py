@@ -7,6 +7,9 @@ input is always images/<W>x<H>.pgm and the turn counter starts at 0
 the rule, so a run can continue exactly where it stopped: bit-identical to
 an uninterrupted run (tests/test_checkpoint.py).
 
+Resume ≡ uninterrupted run is proven bit-identical by
+tests/test_aux.py::test_resume_equals_uninterrupted_run.
+
 Format: a plain .npz — board (uint8 [H, W]), turn (int), rulestring (str).
 """
 
@@ -20,6 +23,9 @@ from ..models import CONWAY, LifeRule
 
 
 def save_checkpoint(path, world, turn: int, rule: LifeRule = CONWAY) -> pathlib.Path:
+    """Returns the path actually written: ``np.savez_compressed`` appends
+    ``.npz`` whenever the name doesn't already end with it (so e.g.
+    ``ck.backup`` lands at ``ck.backup.npz``)."""
     path = pathlib.Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     np.savez_compressed(
@@ -28,7 +34,7 @@ def save_checkpoint(path, world, turn: int, rule: LifeRule = CONWAY) -> pathlib.
         turn=np.int64(turn),
         rulestring=np.str_(rule.rulestring),
     )
-    return path if path.suffix == ".npz" else path.with_suffix(path.suffix + ".npz")
+    return path if path.suffix == ".npz" else path.with_name(path.name + ".npz")
 
 
 def load_checkpoint(path) -> tuple[np.ndarray, int, LifeRule]:

@@ -317,8 +317,9 @@ def serve(
     port: int = 8040,
     backend: str = "tpu",
     worker_addresses: list[str] | None = None,
+    host: str = "127.0.0.1",
 ) -> tuple[RpcServer, BrokerService]:
-    server = RpcServer(port=port)
+    server = RpcServer(host=host, port=port)
     impl = (
         WorkersBackend(worker_addresses or [])
         if backend == "workers"
@@ -345,9 +346,13 @@ def main(argv=None) -> None:
         "-workers", default="",
         help="comma-separated worker addresses for -backend workers",
     )
+    parser.add_argument(
+        "-host", default="127.0.0.1",
+        help="bind address; 0.0.0.0 opts into external exposure",
+    )
     args = parser.parse_args(argv)
     addresses = [a for a in args.workers.split(",") if a]
-    server, service = serve(args.port, args.backend, addresses)
+    server, service = serve(args.port, args.backend, addresses, host=args.host)
     print(f"broker listening on :{server.port} (backend={args.backend})", flush=True)
     service.quit_event.wait()
 

@@ -25,6 +25,10 @@ class RpcClient:
         host, port = address.rsplit(":", 1)
         self._sock = socket.create_connection((host, int(port)), timeout=timeout)
         self._sock.settimeout(None)
+        # send_frame writes header and payload separately; without NODELAY
+        # Nagle holds the second small write for the peer's delayed ACK
+        # (~40-200 ms per call — fatal for a per-turn scatter/gather)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._write_lock = threading.Lock()
         self._ids = itertools.count()
         self._pending: dict[int, dict] = {}
@@ -34,6 +38,9 @@ class RpcClient:
         self._reader.start()
 
     def _read_loop(self) -> None:
+        # broad catch: an allowlist-rejected or corrupt reply frame
+        # (pickle.UnpicklingError, EOFError, ...) must fail every pending
+        # call, not silently kill this thread and hang them forever
         try:
             while True:
                 msg = recv_frame(self._sock)
@@ -42,7 +49,7 @@ class RpcClient:
                 if slot is not None:
                     slot["reply"] = msg
                     slot["event"].set()
-        except (ConnectionError, OSError):
+        except Exception:
             self._closed.set()
             with self._pending_lock:
                 for slot in self._pending.values():

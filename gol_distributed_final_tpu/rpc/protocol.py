@@ -5,14 +5,18 @@ Method names and wire-struct fields mirror the reference's stubs package
 whole game, Retrieve snapshots, Pause toggles, Quit detaches, SuperQuit
 shuts the system down, Update computes one strip — carry over verbatim.
 
-Transport is length-prefixed pickle frames over TCP (the Go reference uses
-gob over TCP, net/rpc — same trust model: a private, same-deployment
-boundary, not an internet-facing API).
+Transport is length-prefixed pickle frames over TCP. Unlike Go's gob, raw
+pickle is a code-execution primitive, so the trust posture is hardened past
+the reference's: servers bind loopback by default (rpc/server.py) and
+deserialisation goes through a restricted Unpickler that only resolves the
+wire vocabulary — Request/Response, Cell, and numpy array reconstruction —
+rejecting every other global (ADVICE.md round 1).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import io
 import pickle
 import struct
 from typing import List, Optional
@@ -60,6 +64,41 @@ class Response:
     worker: int = 0
 
 
+# -- deserialisation allowlist ----------------------------------------------
+
+# every global a legitimate frame can reference: the wire dataclasses, the
+# Cell payload type, and numpy's array/scalar reconstruction machinery
+# (module path differs across numpy 1.x/2.x)
+_ALLOWED_GLOBALS = {
+    (__name__, "Request"),
+    (__name__, "Response"),
+    ("gol_distributed_final_tpu.utils.cell", "Cell"),
+    ("numpy.core.multiarray", "_reconstruct"),
+    ("numpy._core.multiarray", "_reconstruct"),
+    ("numpy.core.multiarray", "scalar"),
+    ("numpy._core.multiarray", "scalar"),
+    # protocol-5 contiguous-array path (what the wire actually uses)
+    ("numpy.core.numeric", "_frombuffer"),
+    ("numpy._core.numeric", "_frombuffer"),
+    ("numpy", "ndarray"),
+    ("numpy", "dtype"),
+    ("_codecs", "encode"),  # numpy string-dtype reconstruction (proto <= 2)
+}
+
+
+class _RestrictedUnpickler(pickle.Unpickler):
+    def find_class(self, module, name):
+        if (module, name) in _ALLOWED_GLOBALS:
+            return super().find_class(module, name)
+        raise pickle.UnpicklingError(
+            f"frame references forbidden global {module}.{name}"
+        )
+
+
+def loads_restricted(payload: bytes):
+    return _RestrictedUnpickler(io.BytesIO(payload)).load()
+
+
 # -- framing ----------------------------------------------------------------
 
 _HEADER = struct.Struct(">Q")
@@ -90,4 +129,4 @@ def recv_frame(sock):
     (length,) = _HEADER.unpack(_recv_exact(sock, _HEADER.size))
     if length > MAX_FRAME:
         raise ConnectionError(f"frame of {length} bytes exceeds limit")
-    return pickle.loads(_recv_exact(sock, length))
+    return loads_restricted(_recv_exact(sock, length))

@@ -15,7 +15,12 @@ from .protocol import recv_frame, send_frame
 
 
 class RpcServer:
-    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+    """Binds loopback by default: the transport is pickle-based, so exposure
+    beyond the local deployment must be an explicit operator choice
+    (``host="0.0.0.0"`` / the -host flag), and even then frames only
+    deserialise through the protocol allowlist (protocol.loads_restricted)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -41,6 +46,9 @@ class RpcServer:
                 conn, _ = self._sock.accept()
             except OSError:
                 break  # listener closed by stop()
+            # see RpcClient: reply frames are two writes; Nagle + delayed
+            # ACK would add ~40-200 ms to every small reply
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             threading.Thread(
                 target=self._serve_conn, args=(conn,), daemon=True
             ).start()
@@ -52,6 +60,11 @@ class RpcServer:
                 try:
                     msg = recv_frame(conn)
                 except (ConnectionError, OSError):
+                    return
+                except Exception:
+                    # forbidden global (pickle.UnpicklingError), truncated
+                    # pickle (EOFError), or any other malformed frame: drop
+                    # the peer — nothing on this connection can be trusted
                     return
                 threading.Thread(
                     target=self._dispatch,

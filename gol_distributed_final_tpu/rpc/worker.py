@@ -1,12 +1,14 @@
 """The worker process — GameOfLifeOperations service (worker/worker.go:72-112).
 
 Serves ``Update`` (compute one row strip of the next board state) and
-``WorkerQuit``. The strip kernel is the jitted XLA stencil: the broker sends
-the strip plus its two wrap-around halo rows, and the worker returns the
-evolved strip — unlike the reference, which ships the ENTIRE board to every
-worker and lets each one index its strip (worker/worker.go:78,
-broker/broker.go:144). The wire cost drops from O(H x W) to
-O(strip + 2 rows) per call while preserving the verbs.
+``WorkerQuit``. The strip kernel is a vectorized numpy stencil (see
+``_strip_step`` — this is the reference-shaped CPU plane; the TPU plane
+lives in the engine): the broker sends the strip plus its two wrap-around
+halo rows, and the worker returns the evolved strip — unlike the
+reference, which ships the ENTIRE board to every worker and lets each one
+index its strip (worker/worker.go:78, broker/broker.go:144). The wire cost
+drops from O(H x W) to O(strip + 2 rows) per call while preserving the
+verbs.
 
 For reference-exact wire behavior the worker also accepts full-board
 requests (halo rows derived locally) — the broker chooses per its
@@ -16,7 +18,6 @@ requests (halo rows derived locally) — the broker chooses per its
 from __future__ import annotations
 
 import argparse
-import functools
 import threading
 
 import numpy as np
@@ -25,29 +26,25 @@ from .protocol import Methods, Request, Response
 from .server import RpcServer
 
 
-@functools.lru_cache(maxsize=None)
-def _strip_step():
-    """(h+2, w) padded strip -> (h, w) next strip, columns wrapping locally."""
-    import jax
-    import jax.numpy as jnp
+def _strip_step(padded: np.ndarray) -> np.ndarray:
+    """(h+2, w) padded strip -> (h, w) next strip, columns wrapping locally.
 
-    from ..models import CONWAY
-    from ..ops.stencil import apply_rule, counts_from_extended
-
-    @jax.jit
-    def step(padded):
-        ext = jnp.concatenate([padded[:, -1:], padded, padded[:, :1]], axis=1)
-        h = padded.shape[0] - 2
-        w = padded.shape[1]
-        counts = counts_from_extended(ext, h, w)
-        return apply_rule(
-            padded[1:-1],
-            counts,
-            birth_mask=CONWAY.birth_mask,
-            survive_mask=CONWAY.survive_mask,
-        )
-
-    return step
+    Deliberately a vectorized NUMPY kernel, not jax: this is the
+    reference-shaped CPU worker (its kernel is a plain Go loop,
+    worker/worker.go:15-70, Conway hard-coded :41-46), called once per
+    strip per turn — per-call jax dispatch overhead would dominate a
+    sub-millisecond stencil. The TPU data plane lives in the engine
+    (ops/, parallel/), not here."""
+    ext = np.concatenate([padded[:, -1:], padded, padded[:, :1]], axis=1)
+    b = (ext != 0).astype(np.uint8)
+    counts = (
+        b[:-2, :-2].astype(np.int32) + b[:-2, 1:-1] + b[:-2, 2:]
+        + b[1:-1, :-2] + b[1:-1, 2:]
+        + b[2:, :-2] + b[2:, 1:-1] + b[2:, 2:]
+    )
+    alive = b[1:-1, 1:-1] == 1
+    next_alive = np.where(alive, (counts == 2) | (counts == 3), counts == 3)
+    return np.where(next_alive, 255, 0).astype(np.uint8)
 
 
 def compute_strip(world: np.ndarray, start_y: int, end_y: int) -> np.ndarray:
@@ -56,12 +53,12 @@ def compute_strip(world: np.ndarray, start_y: int, end_y: int) -> np.ndarray:
     h = world.shape[0]
     rows = np.arange(start_y - 1, end_y + 1) % h
     padded = world[rows]
-    return np.asarray(_strip_step()(padded))
+    return _strip_step(padded)
 
 
 def compute_strip_haloed(padded: np.ndarray) -> np.ndarray:
     """Next state of a strip sent WITH its halo rows (rows 0 and -1)."""
-    return np.asarray(_strip_step()(padded))
+    return _strip_step(padded)
 
 
 class WorkerService:
@@ -88,8 +85,8 @@ class WorkerService:
         self.quit_event.set()
 
 
-def serve(port: int = 8030) -> tuple[RpcServer, WorkerService]:
-    server = RpcServer(port=port)
+def serve(port: int = 8030, host: str = "127.0.0.1") -> tuple[RpcServer, WorkerService]:
+    server = RpcServer(host=host, port=port)
     service = WorkerService(server)
     server.register(Methods.WORKER_UPDATE, service.update)
     server.register(Methods.WORKER_QUIT, service.worker_quit)
@@ -100,8 +97,12 @@ def serve(port: int = 8030) -> tuple[RpcServer, WorkerService]:
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(description="GoL worker node")
     parser.add_argument("-port", type=int, default=8030)
+    parser.add_argument(
+        "-host", default="127.0.0.1",
+        help="bind address; 0.0.0.0 opts into external exposure",
+    )
     args = parser.parse_args(argv)
-    server, service = serve(args.port)
+    server, service = serve(args.port, args.host)
     print(f"worker listening on :{server.port}", flush=True)
     service.quit_event.wait()
 

@@ -68,9 +68,29 @@ class SdlWindow(Window):
     def __init__(self, width: int, height: int, title: str = "GoL"):
         super().__init__(width, height, title)
         lib = ctypes.CDLL(str(_WINDOW_LIB))
+        # declare EVERY signature: on LP64 an undeclared handle argument
+        # would be truncated to a 32-bit c_int (ADVICE/VERDICT round 1)
         lib.golwin_create.restype = ctypes.c_void_p
         lib.golwin_create.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_char_p]
+        lib.golwin_flip_pixel.restype = None
+        lib.golwin_flip_pixel.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_int]
+        lib.golwin_set_pixel.restype = None
+        lib.golwin_set_pixel.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_uint32,
+        ]
+        lib.golwin_count_pixels.restype = ctypes.c_long
+        lib.golwin_count_pixels.argtypes = [ctypes.c_void_p]
+        lib.golwin_clear_pixels.restype = None
+        lib.golwin_clear_pixels.argtypes = [ctypes.c_void_p]
+        lib.golwin_render_frame.restype = None
+        lib.golwin_render_frame.argtypes = [ctypes.c_void_p]
         lib.golwin_poll_key.restype = ctypes.c_int
+        lib.golwin_poll_key.argtypes = [ctypes.c_void_p]
+        lib.golwin_destroy.restype = None
+        lib.golwin_destroy.argtypes = [ctypes.c_void_p]
         self._lib = lib
         self._handle = ctypes.c_void_p(
             lib.golwin_create(width, height, title.encode())

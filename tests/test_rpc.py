@@ -189,3 +189,191 @@ def test_unknown_method_and_bad_worker():
         client.call("Operations.Nope", Request())
     client.close()
     server.stop()
+
+
+# -- elastic recovery (the extension the reference leaves unimplemented:
+# its gather hangs on worker death, README.md:266-270) ----------------------
+
+
+def _poll_turn(remote, minimum, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        snap = remote.retrieve(include_world=False)
+        if snap.turns_completed >= minimum:
+            return snap.turns_completed
+        time.sleep(0.02)
+    raise TimeoutError(f"run never reached turn {minimum}")
+
+
+def test_worker_killed_mid_run_resplits_golden(tmp_path):
+    """SIGKILL one of three workers mid-run: the broker drops it, re-splits
+    its rows over the survivors, RECOMPUTES the interrupted turn from the
+    pre-turn world, and the run completes with exact alive-count parity."""
+    turns = 3000
+    workers = [
+        _spawn("gol_distributed_final_tpu.rpc.worker", "-port", "0")
+        for _ in range(3)
+    ]
+    broker = None
+    try:
+        ports = [_wait_listening(w) for w in workers]
+        addrs = ",".join(f"127.0.0.1:{p}" for p in ports)
+        broker = _spawn(
+            "gol_distributed_final_tpu.rpc.broker",
+            "-port", "0", "-backend", "workers", "-workers", addrs,
+        )
+        address = f"127.0.0.1:{_wait_listening(broker)}"
+
+        p = Params(turns=turns, threads=3, image_width=64, image_height=64)
+        import gol_distributed_final_tpu.io.pgm as pgm
+
+        board = pgm.read_board(p, REPO_ROOT / "images")
+        remote = RemoteBroker(address, timeout=10.0)
+        result = {}
+        t = threading.Thread(
+            target=lambda: result.update(r=remote.run(p, board))
+        )
+        t.start()
+        try:
+            reached = _poll_turn(remote, turns // 6)
+            workers[1].kill()  # SIGKILL, mid-run
+            workers[1].wait()
+            t.join(timeout=120)
+            assert not t.is_alive(), "run did not survive the worker loss"
+        finally:
+            if t.is_alive():
+                remote.quit()
+                t.join(timeout=30)
+            remote.close()
+        r = result["r"]
+        assert r.turns_completed == turns
+        assert reached < turns  # the kill really happened mid-run
+        from helpers import read_alive_counts
+
+        want = read_alive_counts(REPO_ROOT / "check" / "alive" / "64x64.csv")
+        assert len(r.alive) == want[turns]
+    finally:
+        for proc in (*workers, *([broker] if broker else [])):
+            if proc.poll() is None:
+                proc.kill()
+            proc.wait()
+
+
+def test_all_workers_lost_errors_cleanly(tmp_path):
+    """Losing EVERY worker mid-run surfaces a clean RpcError to the blocked
+    Run call instead of hanging the gather like the reference."""
+    worker = _spawn("gol_distributed_final_tpu.rpc.worker", "-port", "0")
+    broker = None
+    try:
+        port = _wait_listening(worker)
+        broker = _spawn(
+            "gol_distributed_final_tpu.rpc.broker",
+            "-port", "0", "-backend", "workers",
+            "-workers", f"127.0.0.1:{port}",
+        )
+        address = f"127.0.0.1:{_wait_listening(broker)}"
+
+        p = Params(turns=10**7, threads=1, image_width=64, image_height=64)
+        import gol_distributed_final_tpu.io.pgm as pgm
+
+        board = pgm.read_board(p, REPO_ROOT / "images")
+        remote = RemoteBroker(address, timeout=10.0)
+        try:
+            errors = {}
+
+            def runner():
+                try:
+                    remote.run(p, board)
+                except RpcError as e:
+                    errors["e"] = e
+
+            t = threading.Thread(target=runner)
+            t.start()
+            _poll_turn(remote, 10)
+            worker.kill()
+            worker.wait()
+            t.join(timeout=60)
+            assert not t.is_alive(), "Run hung after losing all workers"
+            assert "all workers lost" in str(errors["e"])
+        finally:
+            remote.close()
+    finally:
+        for proc in (worker, *([broker] if broker else [])):
+            if proc.poll() is None:
+                proc.kill()
+            proc.wait()
+
+
+# -- transport hardening (ADVICE.md round 1) --------------------------------
+
+
+def test_restricted_unpickler_rejects_forbidden_globals():
+    """The wire deserialiser must refuse anything outside the protocol
+    vocabulary — a pickle that resolves os.system is an RCE attempt."""
+    import pickle
+
+    from gol_distributed_final_tpu.rpc.protocol import loads_restricted
+
+    class Evil:
+        def __reduce__(self):
+            return (os.system, ("true",))
+
+    proto = pickle.HIGHEST_PROTOCOL  # what send_frame uses on the wire
+    payload = pickle.dumps({"id": 0, "method": "x", "request": Evil()}, protocol=proto)
+    with pytest.raises(pickle.UnpicklingError, match="forbidden global"):
+        loads_restricted(payload)
+
+    # the legitimate vocabulary still round-trips, at every pickle protocol
+    from gol_distributed_final_tpu.utils.cell import Cell
+
+    req = Request(world=np.arange(16, dtype=np.uint8).reshape(4, 4), turns=3)
+    for pr in (2, 4, proto):
+        frame = {"id": 1, "request": req, "cells": [Cell(1, 2)], "n": np.int64(7)}
+        out = loads_restricted(pickle.dumps(frame, protocol=pr))
+        assert out["request"].turns == 3 and out["cells"] == [Cell(1, 2)]
+        np.testing.assert_array_equal(out["request"].world, req.world)
+
+
+def test_server_drops_connection_on_malicious_frame(tmp_path):
+    """A forbidden frame kills only that connection; the server keeps
+    serving honest peers, and the payload is never executed."""
+    import pickle
+    import socket
+    import struct
+
+    from gol_distributed_final_tpu.rpc.server import RpcServer
+
+    canary = str(tmp_path / "pwned.txt")
+
+    class Evil:
+        def __reduce__(self):
+            return (os.system, (f"touch {canary}",))
+
+    server = RpcServer(port=0)
+    server.register("Echo.Echo", lambda req: req)
+    server.serve_background()
+    try:
+        evil = pickle.dumps({"id": 0, "method": "Echo.Echo", "request": Evil()})
+        s = socket.create_connection(("127.0.0.1", server.port), timeout=5)
+        s.sendall(struct.pack(">Q", len(evil)) + evil)
+        # server must close on us without executing anything
+        s.settimeout(5)
+        assert s.recv(1) == b""  # EOF: connection dropped
+        s.close()
+        assert not os.path.exists(canary), "malicious payload executed!"
+
+        # an honest client on a fresh connection still gets service
+        client = RpcClient(f"127.0.0.1:{server.port}")
+        res = client.call("Echo.Echo", Request(turns=7))
+        assert res.turns == 7
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_server_binds_loopback_by_default():
+    from gol_distributed_final_tpu.rpc.server import RpcServer
+
+    server = RpcServer(port=0)
+    assert server._sock.getsockname()[0] == "127.0.0.1"
+    server.stop()
