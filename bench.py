@@ -11,11 +11,16 @@ single-chip BASELINE configs:
   config 4: 4096x4096 — XLA bitboard (the packed board exceeds the
             measured VMEM working-set budget, ops/pallas_stencil.fits_vmem,
             so the gate routes to the HBM-resident XLA bitboard step)
+  config 5 (single-chip shape): 16384^2 sparse R-pentomino via the
+            streamed big-board path (bigboard.py) — the board exists only
+            as a 32 MiB packed bitboard on device
 
 Parity gates: exact alive counts against check/alive/512x512.csv at turns
 1000 and 10000 plus the period-2 steady state; 128^2 against a numpy
 oracle at 1000 turns; 4096^2 bitboard against the independent roll-stencil
-implementation at 100 turns (on-device array equality).
+implementation at 100 turns (on-device array equality); 16384^2
+R-pentomino against the oracle-validated 1000-turn population (156,
+verified on a 1536^2 window with envelope check).
 
 Methodology: the remote-TPU tunnel adds a fixed ~0.1 s dispatch+transfer
 overhead per call, so throughput is the MARGINAL cost between an n_lo- and
@@ -207,6 +212,28 @@ def main() -> int:
     pt4k, det4k = marginal(evolve4k, n4_lo, n4_hi)
     extra["c4_4096_xla_bitboard"] = dict(
         det4k, cell_updates_per_s=round(4096 * 4096 / pt4k)
+    )
+
+    # ---- config 5 shape: 16384^2 sparse, streamed big-board path ---------
+    from gol_distributed_final_tpu.bigboard import r_pentomino, seed_packed
+
+    state16k = seed_packed(16384, r_pentomino(16384))
+    plane16k = BitPlane(CONWAY, word_axis)
+    # device-side popcount: the 16384^2 board stays packed on device
+    alive = bitpack.alive_count_packed(plane16k.step_n(state16k, 1000))
+    if alive != 156:  # oracle-validated (tests/test_bigboard.py methodology)
+        print(f"PARITY FAILURE 16384^2: {alive} != 156", file=sys.stderr)
+        return 1
+    print("parity 16384^2 ok (R-pentomino, 1000 turns)", file=sys.stderr)
+
+    def evolve16k(n):
+        return np.asarray(plane16k.step_n(state16k, n))
+
+    n5_lo, n5_hi = 200, 1_200
+    evolve16k(n5_lo), evolve16k(n5_hi)
+    pt16k, det16k = marginal(evolve16k, n5_lo, n5_hi)
+    extra["c5_16384_sparse_bigboard"] = dict(
+        det16k, cell_updates_per_s=round(16384 * 16384 / pt16k)
     )
 
     print(

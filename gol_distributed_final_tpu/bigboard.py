@@ -1,0 +1,165 @@
+"""Config-5-shaped runs: sparse boards too large to ever exist as bytes.
+
+BASELINE config 5 is a 65536^2 sparse board (R-pentomino seeded) — as a
+byte raster that is 4 GiB; the reference materialises the full board in
+the controller, the broker AND every worker (SURVEY.md §5), capping board
+size at one machine's RAM. Here the board only ever exists as the int32
+bitboard on device (32x smaller), is seeded directly from sparse cell
+coordinates, evolves through the XLA bitboard plane (ops/plane.BitPlane —
+boards this size are far past the VMEM-kernel gate), and reaches disk as
+a stream of unpacked ROW BLOCKS through io/sharded.py pwrites. The full
+byte board never exists on host or device.
+
+    state  = seed_packed(16384, r_pentomino(16384))   # 32 MiB, device
+    state  = plane.step_n(state, turns)               # XLA bitboard
+    stream_packed_to_pgm(path, state, row_block=1024) # 16 MiB blocks
+
+Reading streams the same way (``load_packed_from_pgm``): row blocks are
+packed on device block-by-block.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Iterable, Sequence, Tuple
+
+import numpy as np
+
+from .models import CONWAY, LifeRule
+from .ops.bitpack import WORD, alive_count_packed, pack_device, unpack_device
+from .ops.plane import BitPlane
+
+Cells = Iterable[Tuple[int, int]]  # (x, y) pairs
+
+
+def r_pentomino(size: int) -> list[tuple[int, int]]:
+    """The classic methuselah, centred — the BASELINE config-5 seed."""
+    cx = cy = size // 2
+    offsets = [(1, 0), (2, 0), (0, 1), (1, 1), (1, 2)]
+    return [(cx + dx, cy + dy) for dx, dy in offsets]
+
+
+def seed_packed(size: int, cells: Cells, word_axis: int = 0):
+    """A packed device bitboard with only ``cells`` alive.
+
+    Sparse construction: the dense byte board is never built — word
+    indices and bit masks are computed host-side from the coordinate list
+    (O(len(cells))), then scattered into a device array of zeros."""
+    import jax.numpy as jnp
+
+    if size % WORD:
+        raise ValueError(f"size {size} not divisible by {WORD}")
+    shape = (size // WORD, size) if word_axis == 0 else (size, size // WORD)
+    rows, cols, bits = [], [], []
+    for x, y in cells:
+        if not (0 <= x < size and 0 <= y < size):
+            raise ValueError(f"cell ({x}, {y}) outside {size}x{size}")
+        if word_axis == 0:
+            rows.append(y // WORD)
+            cols.append(x)
+            bits.append(y % WORD)
+        else:
+            rows.append(y)
+            cols.append(x // WORD)
+            bits.append(x % WORD)
+    packed = np.zeros(shape, np.uint32)
+    np.bitwise_or.at(
+        packed, (np.asarray(rows, np.int64), np.asarray(cols, np.int64)),
+        np.uint32(1) << np.asarray(bits, np.uint32),
+    )
+    return jnp.asarray(packed.view(np.int32))
+
+
+def stream_packed_to_pgm(path, state, word_axis: int = 0, row_block: int = 1024):
+    """Write the bitboard to a P5 PGM in row blocks: at most
+    ``row_block x W`` bytes exist at once (io/sharded.py pwrites)."""
+    from .io.sharded import create_pgm, write_rows_at
+
+    if word_axis == 0:
+        height, width = state.shape[0] * WORD, state.shape[1]
+    else:
+        height, width = state.shape[0], state.shape[1] * WORD
+    row_block = max(WORD, row_block - row_block % WORD)
+    offset = create_pgm(path, width, height)
+    for start in range(0, height, row_block):
+        stop = min(start + row_block, height)
+        if word_axis == 0:
+            block = state[start // WORD : stop // WORD]
+        else:
+            block = state[start:stop]
+        rows = np.asarray(unpack_device(block, word_axis))
+        write_rows_at(path, offset, width, start, rows)
+
+
+def load_packed_from_pgm(path, word_axis: int = 0, row_block: int = 1024):
+    """Stream a P5 PGM into a packed device bitboard, block by block."""
+    import jax.numpy as jnp
+
+    from .io.pgm import PgmReader
+    from .io.sharded import read_shard
+
+    with PgmReader(path) as r:
+        width, height = r.width, r.height
+    if height % WORD or width % WORD:
+        raise ValueError(f"{width}x{height} not divisible by {WORD}")
+    row_block = max(WORD, row_block - row_block % WORD)
+    blocks = []
+    for start in range(0, height, row_block):
+        stop = min(start + row_block, height)
+        rows = read_shard(path, start, stop)
+        blocks.append(pack_device(jnp.asarray(rows), word_axis))
+    return jnp.concatenate(blocks, axis=0)
+
+
+def run_big_board(
+    size: int,
+    turns: int,
+    out_path,
+    *,
+    cells: Sequence[tuple[int, int]] | None = None,
+    in_path=None,
+    rule: LifeRule = CONWAY,
+    word_axis: int = 0,
+    row_block: int = 1024,
+) -> int:
+    """Seed (sparse cells or a streamed PGM), evolve, stream out.
+
+    Returns the final alive count (device-side popcount). The full byte
+    board never exists anywhere; peak host memory is one row block."""
+    if (cells is None) == (in_path is None):
+        raise ValueError("exactly one of cells / in_path must be given")
+    if cells is not None:
+        state = seed_packed(size, cells, word_axis)
+    else:
+        state = load_packed_from_pgm(in_path, word_axis, row_block)
+    plane = BitPlane(rule, word_axis)
+    if turns:
+        state = plane.step_n(state, turns)
+    if out_path is not None:
+        stream_packed_to_pgm(out_path, state, word_axis, row_block)
+    return alive_count_packed(state)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="sparse big-board run (BASELINE config 5 shape)"
+    )
+    parser.add_argument("-size", type=int, default=16384)
+    parser.add_argument("-turns", type=int, default=1000)
+    parser.add_argument("-out", default="out/bigboard.pgm")
+    parser.add_argument("-in", dest="in_path", default=None,
+                        help="seed from a PGM instead of the R-pentomino")
+    parser.add_argument("-row-block", type=int, default=1024)
+    args = parser.parse_args(argv)
+    cells = None if args.in_path else r_pentomino(args.size)
+    alive = run_big_board(
+        args.size, args.turns, args.out,
+        cells=cells, in_path=args.in_path, row_block=args.row_block,
+    )
+    print(f"alive {alive}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,11 +23,21 @@ import numpy as np
 from .pgm import PgmError, PgmReader
 
 
+def pgm_raster_offset(width: int, height: int) -> int:
+    """Byte offset of the raster in a PGM created by ``create_pgm`` — what
+    a rank that did NOT create the file passes to ``write_rows_at``."""
+    return len(_pgm_header(width, height))
+
+
+def _pgm_header(width: int, height: int) -> bytes:
+    return b"P5\n%d %d\n255\n" % (width, height)
+
+
 def create_pgm(path, width: int, height: int) -> int:
     """Write the P5 header and pre-size the raster; returns raster offset."""
     path = pathlib.Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
-    header = b"P5\n%d %d\n255\n" % (width, height)
+    header = _pgm_header(width, height)
     with open(path, "wb") as f:
         f.write(header)
         f.truncate(len(header) + width * height)

@@ -59,12 +59,11 @@ class RpcServer:
             while True:
                 try:
                     msg = recv_frame(conn)
-                except (ConnectionError, OSError):
-                    return
                 except Exception:
-                    # forbidden global (pickle.UnpicklingError), truncated
-                    # pickle (EOFError), or any other malformed frame: drop
-                    # the peer — nothing on this connection can be trusted
+                    # disconnect (ConnectionError/OSError), forbidden global
+                    # (pickle.UnpicklingError), truncated pickle (EOFError),
+                    # or any other malformed frame: drop the peer — nothing
+                    # on this connection can be trusted
                     return
                 threading.Thread(
                     target=self._dispatch,

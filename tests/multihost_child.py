@@ -1,0 +1,100 @@
+"""Child process for the multi-host end-to-end test (tests/test_multihost.py).
+
+One of N processes in a real ``jax.distributed`` job over the CPU backend:
+each process owns 4 virtual devices, the board is sharded over the GLOBAL
+('rows', 'cols') mesh spanning all processes, and each process touches ONLY
+its own row range of the on-disk PGM (parallel/multihost.host_row_range +
+io/sharded.py) — the BASELINE config-5 IO pattern at test scale.
+
+Usage: multihost_child.py <coordinator> <num_procs> <proc_id> <images_dir>
+       <out_path> <turns>
+
+Reference anchor: the reference scales to more machines by adding worker
+addresses (broker/broker.go:288-300) and shipping the full board to each;
+here a process joins the job and only ever holds its shard.
+"""
+
+import pathlib
+import sys
+
+import numpy as np
+
+
+def main() -> int:
+    coordinator, num_procs, proc_id, images_dir, out_path, turns = sys.argv[1:7]
+    num_procs, proc_id, turns = int(num_procs), int(proc_id), int(turns)
+
+    import jax
+
+    from gol_distributed_final_tpu.parallel import multihost
+    from gol_distributed_final_tpu.parallel import (
+        make_bit_plane,
+        make_mesh,
+        sharded_step_n_fn,
+    )
+    from gol_distributed_final_tpu.parallel.halo import board_sharding
+    from gol_distributed_final_tpu.io.sharded import (
+        create_pgm,
+        pgm_raster_offset,
+        read_shard,
+        write_rows_at,
+    )
+
+    assert multihost.initialize(coordinator, num_procs, proc_id)
+    assert multihost.process_count() == num_procs
+    devices = jax.devices()
+    assert len(devices) == 4 * num_procs, f"global devices: {len(devices)}"
+
+    size = 64
+    # rows axis == processes (jax.devices() is process-major), cols local
+    mesh = make_mesh((num_procs, 4), devices=devices)
+    lo, hi = multihost.host_row_range(mesh, size)
+    expected_rows = size // num_procs
+    assert hi - lo == expected_rows and lo == proc_id * expected_rows
+
+    # per-host streamed read: ONLY this host's rows leave the disk
+    local = read_shard(pathlib.Path(images_dir) / f"{size}x{size}.pgm", lo, hi)
+    sharding = board_sharding(mesh)
+    board = jax.make_array_from_process_local_data(sharding, local, (size, size))
+
+    # evolve on the global mesh: halo ppermutes cross the process boundary
+    step_n = sharded_step_n_fn(mesh)
+    out = step_n(board, turns)
+    out.block_until_ready()
+
+    # the fast plane, same topology: mesh-sharded bitboard parity
+    plane = make_bit_plane(mesh, (size, size))
+    assert plane is not None
+    state = plane.step_n(plane.encode(board), turns)
+    bit_out = plane._decode(state)  # stays a global sharded device array
+
+    # gather each array's LOCAL rows and compare shard-wise
+    def local_rows(arr):
+        rows = np.full((hi - lo, size), 255, np.uint8)  # poison non-owned
+        for shard in arr.addressable_shards:
+            r0, c0 = (idx.start or 0 for idx in shard.index)
+            data = np.asarray(shard.data)
+            rows[r0 - lo : r0 - lo + data.shape[0], c0 : c0 + data.shape[1]] = data
+        return rows
+
+    mine = local_rows(out)
+    np.testing.assert_array_equal(local_rows(bit_out), mine)
+
+    # per-host streamed write, disjoint pwrites (io/sharded.py)
+    out_path = pathlib.Path(out_path)
+    if proc_id == 0:
+        offset = create_pgm(out_path, size, size)
+    else:
+        offset = pgm_raster_offset(size, size)
+    # cross-process barrier so rank!=0 never writes before the file is sized
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices("pgm_created")
+    write_rows_at(out_path, offset, size, lo, mine)
+    multihost_utils.sync_global_devices("pgm_written")
+    print(f"rank {proc_id} rows [{lo}, {hi}) done", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,75 @@
+"""Multi-host end-to-end: a REAL 2-process jax.distributed job.
+
+VERDICT.md round-1 item 5: two subprocesses x 4 virtual CPU devices each
+join via ``jax.distributed.initialize``, shard the board over the global
+('rows', 'cols') mesh, evolve 100 turns with halo ppermutes crossing the
+process boundary, and stream the result to one PGM via per-host disjoint
+pwrites (``host_row_range`` + io/sharded.py). The parent asserts golden
+parity byte-for-byte. This is the BASELINE config-5 topology at test scale
+(the reference's analogue: more worker addresses in the broker list,
+broker/broker.go:288-300).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from helpers import REPO_ROOT
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.parametrize("turns", [100])
+def test_two_process_distributed_golden(tmp_path, turns):
+    num_procs = 2
+    coordinator = f"127.0.0.1:{_free_port()}"
+    out_path = tmp_path / f"64x64x{turns}.pgm"
+    procs = []
+    for rank in range(num_procs):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env["PYTHONPATH"] = str(REPO_ROOT)
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable,
+                    str(REPO_ROOT / "tests" / "multihost_child.py"),
+                    coordinator,
+                    str(num_procs),
+                    str(rank),
+                    str(REPO_ROOT / "images"),
+                    str(out_path),
+                    str(turns),
+                ],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    try:
+        outputs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outputs.append(out)
+        for rank, (p, out) in enumerate(zip(procs, outputs)):
+            assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+    got = out_path.read_bytes()
+    want = (REPO_ROOT / "check" / "images" / f"64x64x{turns}.pgm").read_bytes()
+    assert got == want, "distributed output PGM differs from golden"

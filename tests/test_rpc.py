@@ -284,7 +284,7 @@ def test_all_workers_lost_errors_cleanly(tmp_path):
             def runner():
                 try:
                     remote.run(p, board)
-                except RpcError as e:
+                except Exception as e:  # any failure must reach the assert
                     errors["e"] = e
 
             t = threading.Thread(target=runner)
@@ -294,6 +294,7 @@ def test_all_workers_lost_errors_cleanly(tmp_path):
             worker.wait()
             t.join(timeout=60)
             assert not t.is_alive(), "Run hung after losing all workers"
+            assert isinstance(errors.get("e"), RpcError), errors
             assert "all workers lost" in str(errors["e"])
         finally:
             remote.close()
