@@ -132,16 +132,21 @@ def _compiled(n: int, birth_mask: int, survive_mask: int, interpret: bool):
     return run
 
 
+def pick_rot1(interpret: bool):
+    """The rotate primitive for bitboard kernels: jnp.roll under the
+    interpreter (bit_step never rotates by 0), the Mosaic-safe pltpu.roll
+    wrapper on real TPU. Shared by the whole-board and tiled kernels."""
+    if interpret:
+        return None
+    return functools.partial(_rot1, interpret=False)
+
+
 def _bit_kernel(
     packed_ref, out_ref, *, n, word_axis, interpret, birth_mask, survive_mask
 ):
     from .bitpack import bit_step
 
-    if interpret:
-        rot1 = None  # jnp.roll (bit_step never rotates by 0)
-    else:
-        # the same Mosaic-safe rotate the byte kernel uses (shift % size)
-        rot1 = functools.partial(_rot1, interpret=False)
+    rot1 = pick_rot1(interpret)
 
     out_ref[:] = lax.fori_loop(
         0,

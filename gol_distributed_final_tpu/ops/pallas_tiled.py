@@ -1,0 +1,141 @@
+"""Grid-tiled pallas bitboard kernel — the fast path for boards whose
+packed form exceeds VMEM.
+
+The whole-board VMEM kernel (ops/pallas_stencil.py) tops out at packed
+<= ~1.5 MiB (measured; fits_vmem). Beyond that, round 2's fallback was the
+XLA bitboard step, which at 16384^2 runs ~8x above the HBM-bandwidth floor:
+XLA materialises the ~10 bit-plane intermediates of ``bit_step`` in HBM
+once the working set stops fitting on-chip (measured 617 us/turn vs the
+~80 us floor of read+write 2x32 MiB at ~800 GB/s).
+
+This kernel restores most of it: the packed array is processed in row
+blocks; each grid step sees three views of the SAME array — the previous,
+own, and next block (index maps offset by +-1 modulo the grid, so torus
+wrap falls out of the index arithmetic; Mosaic requires sublane-aligned
+block shapes, which rules out 1-row halo blocks) — and extends its body
+with just the neighbours' edge word-rows (the full bit_step dependency:
+output word (i, j) depends only on words (i+-1, j+-1); column wrap is a
+lane rotate inside the block, which spans the full width). Per turn, HBM
+traffic is ~3x read + 1x write of the packed board, pipelined against
+compute — the bit-plane temporaries (the XLA path's downfall) stay in
+VMEM.
+
+All ``n`` turns run in ONE jitted dispatch (lax.fori_loop around the
+pallas_call), one kernel launch per turn.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .bitpack import bit_step
+from .stencil import CONWAY_BIRTH_MASK, CONWAY_SURVIVE_MASK
+
+# per-block VMEM footprint target: body + 2 halo rows + out + temporaries,
+# double-buffered by the pipeline. 512 KiB blocks keep the working set
+# comfortably inside ~16 MiB VMEM.
+_BLOCK_BYTES_TARGET = 512 * 1024
+
+
+def can_tile(shape: tuple[int, int]) -> bool:
+    """Mosaic block shapes must be sublane(8)-aligned: the packed row count
+    must factor into 8-row blocks with more than one block."""
+    return shape[0] % 8 == 0 and shape[0] // 8 >= 2
+
+
+def _pick_block_rows(packed_rows: int, width: int) -> int:
+    """Largest multiple-of-8 divisor of ``packed_rows`` with block bytes
+    <= target (minimum 8 — the int32 sublane tile)."""
+    limit = max(8, _BLOCK_BYTES_TARGET // (width * 4))
+    divisors = [
+        d
+        for d in range(8, packed_rows, 8)
+        if packed_rows % d == 0 and d <= limit
+    ]
+    return max(divisors) if divisors else 8
+
+
+def _tiled_kernel(
+    top_ref, body_ref, bot_ref, out_ref, *, birth_mask, survive_mask, interpret
+):
+    # only the neighbours' edge word-rows extend the body: temporaries
+    # scale with (pb + 2) rows, not 3*pb
+    ext = jnp.concatenate(
+        [top_ref[-1:, :], body_ref[:], bot_ref[:1, :]], axis=0
+    )
+    from .pallas_stencil import pick_rot1
+
+    rot1 = pick_rot1(interpret)
+    # cyclic rotates only contaminate ext's outer rows, which are sliced
+    out = bit_step(
+        ext, 0, rot1, birth_mask=birth_mask, survive_mask=survive_mask
+    )
+    out_ref[:] = out[1:-1]
+
+
+@functools.lru_cache(maxsize=None)
+def _tiled_compiled(
+    n: int,
+    shape: tuple[int, int],
+    interpret: bool,
+    birth_mask: int = CONWAY_BIRTH_MASK,
+    survive_mask: int = CONWAY_SURVIVE_MASK,
+    block_rows: int | None = None,
+):
+    from jax.experimental import pallas as pl
+
+    rows, width = shape
+    pb = block_rows or _pick_block_rows(rows, width)
+    grid = rows // pb
+    kernel = functools.partial(
+        _tiled_kernel,
+        birth_mask=birth_mask,
+        survive_mask=survive_mask,
+        interpret=interpret,
+    )
+    one_turn = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[
+            # previous, own, next block of the same array; modulo wraps
+            pl.BlockSpec((pb, width), lambda i: ((i - 1) % grid, 0)),
+            pl.BlockSpec((pb, width), lambda i: (i, 0)),
+            pl.BlockSpec((pb, width), lambda i: ((i + 1) % grid, 0)),
+        ],
+        out_specs=pl.BlockSpec((pb, width), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(shape, jnp.int32),
+        interpret=interpret,
+    )
+
+    @jax.jit
+    def run(packed):
+        return lax.fori_loop(0, n, lambda _, p: one_turn(p, p, p), packed)
+
+    return run
+
+
+def tiled_bit_step_n_fn(
+    *,
+    interpret: bool | None = None,
+    rule=None,
+    block_rows: int | None = None,
+):
+    """A ``(packed_int32 [P, W], n) -> packed`` for word_axis=0 bitboards of
+    any size: n turns in one dispatch, one grid-tiled kernel launch per
+    turn, ~BW-floor HBM traffic. Row-packed layout only (the layout every
+    large-board path uses — lanes stay W wide)."""
+    birth = rule.birth_mask if rule else CONWAY_BIRTH_MASK
+    survive = rule.survive_mask if rule else CONWAY_SURVIVE_MASK
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+
+    def step_n(packed, n):
+        return _tiled_compiled(
+            int(n), packed.shape, interpret, birth, survive, block_rows
+        )(packed)
+
+    return step_n

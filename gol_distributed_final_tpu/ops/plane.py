@@ -58,9 +58,12 @@ class BytePlane:
 
 class BitPlane:
     """The int32 bitboard representation: 32 cells/word, state stays packed
-    across chunks. ``step_n`` routes to the pallas VMEM kernel when the
-    packed board fits the measured VMEM working-set budget, else the XLA
-    bitboard step; ``alive_count`` is a popcount — no unpack."""
+    across chunks. ``step_n`` routes by size: the whole-board pallas VMEM
+    kernel under the measured VMEM working-set gate, the grid-tiled pallas
+    kernel for larger boards on real TPU (ops/pallas_tiled.py — the XLA
+    fallback spills the bit-plane temporaries to HBM, ~4.5x slower at
+    16384^2), else the XLA bitboard step; ``alive_count`` is a popcount —
+    no unpack."""
 
     def __init__(
         self,
@@ -86,6 +89,7 @@ class BitPlane:
     def step_n(self, state, n: int):
         from .bitpack import bit_step_n
         from .pallas_stencil import _bit_compiled, fits_vmem
+        from .pallas_tiled import can_tile, tiled_bit_step_n_fn
 
         n = int(n)
         birth, survive = self.rule.birth_mask, self.rule.survive_mask
@@ -93,6 +97,8 @@ class BitPlane:
             return _bit_compiled(n, self.word_axis, self.interpret, birth, survive)(
                 state
             )
+        if not self.interpret and self.word_axis == 0 and can_tile(state.shape):
+            return tiled_bit_step_n_fn(rule=self.rule, interpret=False)(state, n)
         return bit_step_n(state, n, self.word_axis, birth, survive)
 
     def decode(self, state) -> np.ndarray:
