@@ -47,12 +47,31 @@ class Snapshot(NamedTuple):
         return [] if self.world is None else alive_cells(self.world)
 
 
-class RunResult(NamedTuple):
-    """What ``Operations.Run`` returns (broker/broker.go:228-230)."""
+class RunResult:
+    """What ``Operations.Run`` returns (broker/broker.go:228-230).
 
-    turns_completed: int
-    world: np.ndarray
-    alive: List[Cell]
+    ``alive`` is derived from ``world`` on first access, so paths that only
+    ship the world (the RPC reply frames a count + world, never the cell
+    list) don't materialise O(alive) Python Cell objects — ~5M tuples for a
+    dense 4096^2 board."""
+
+    __slots__ = ("turns_completed", "world", "_alive")
+
+    def __init__(
+        self,
+        turns_completed: int,
+        world: np.ndarray,
+        alive: Optional[List[Cell]] = None,
+    ):
+        self.turns_completed = turns_completed
+        self.world = world
+        self._alive = alive
+
+    @property
+    def alive(self) -> List[Cell]:
+        if self._alive is None:
+            self._alive = alive_cells(self.world)
+        return self._alive
 
 
 @dataclasses.dataclass
@@ -230,7 +249,7 @@ class Engine:
                 self._sync_host()
                 world_out = self._world_host
                 turns_done = self._turn
-            return RunResult(turns_done, world_out, alive_cells(world_out))
+            return RunResult(turns_done, world_out)
         finally:
             with self._lock:
                 self._running = False

@@ -25,8 +25,7 @@ import threading
 
 import numpy as np
 
-from ..engine.engine import Engine, EngineConfig, RunResult, Snapshot
-from ..ops import alive_cells
+from ..engine.engine import Engine, RunResult, Snapshot
 from .client import RpcClient, RpcError
 from .protocol import Methods, Request, Response
 from .server import RpcServer
@@ -138,9 +137,7 @@ class WorkersBackend:
             # capture the result BEFORE clearing _running: once the flag
             # drops, a reattaching Run may overwrite _world/_turn
             with self._lock:
-                result = RunResult(
-                    self._turn, self._world, alive_cells(self._world)
-                )
+                result = RunResult(self._turn, self._world)
         finally:
             with self._lock:
                 self._running = False
@@ -268,9 +265,14 @@ class BrokerService:
 
     def run(self, req: Request) -> Response:
         result = self.backend.run(req)
+        # alive stays empty on the wire, like retrieve() below: the client
+        # derives cells from the world it already receives, instead of this
+        # side pickling O(alive) Cell objects (~5M tuples for a dense 4096^2
+        # board). The reference ships them (broker/broker.go:228-230), but
+        # contract parity only requires the controller-visible payload.
         return Response(
-            alive=result.alive,
-            alive_count=len(result.alive),
+            alive=[],
+            alive_count=int(np.count_nonzero(result.world)),
             turns_completed=result.turns_completed,
             world=result.world,
         )

@@ -117,7 +117,11 @@ class RemoteBroker:
         res = self.client.call(Methods.BROKER_RUN, req)
         from ..engine.engine import RunResult
 
-        return RunResult(res.turns_completed, res.world, res.alive)
+        # the broker ships alive=[] (cells are derivable from the world, and
+        # pickling O(alive) Cell objects onto the wire is pure waste) — an
+        # empty list means "derive locally"; a non-empty one is honoured for
+        # compatibility with servers that do ship cells
+        return RunResult(res.turns_completed, res.world, res.alive or None)
 
     def pause(self):
         self.client.call(Methods.PAUSE, Request())

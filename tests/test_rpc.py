@@ -78,8 +78,8 @@ def tpu_broker():
     broker.wait()
 
 
-def _run_remote(address, size, turns, tmp_path, keys=None, tick=3600.0):
-    p = Params(turns=turns, threads=8, image_width=size, image_height=size)
+def _run_remote(address, size, turns, tmp_path, keys=None, tick=3600.0, threads=8):
+    p = Params(turns=turns, threads=threads, image_width=size, image_height=size)
     events = queue.Queue()
     remote = RemoteBroker(address)
     try:
@@ -378,3 +378,102 @@ def test_server_binds_loopback_by_default():
     server = RpcServer(port=0)
     assert server._sock.getsockname()[0] == "127.0.0.1"
     server.stop()
+
+
+# -- TpuBackend's multi-device routing (the branch real multi-chip hardware
+# runs: broker/broker.go:288-311's fan-out, re-founded on the mesh) ---------
+
+
+def test_tpu_backend_mesh_routing_in_process():
+    """On the 8-device test mesh, _plane_for must select the sharded
+    bit-packed plane, Run must hold golden parity through it, and the reply
+    frame must not carry a Cell list (cells are derived client-side)."""
+    import jax
+
+    from gol_distributed_final_tpu.ops import alive_cells
+    from gol_distributed_final_tpu.parallel.bit_halo import ShardedBitPlane
+    from gol_distributed_final_tpu.rpc.broker import BrokerService, TpuBackend
+
+    assert len(jax.devices()) == 8  # conftest's virtual CPU mesh
+    backend = TpuBackend()
+    service = BrokerService(None, backend)  # server only matters for SuperQuit
+    import gol_distributed_final_tpu.io.pgm as pgm
+
+    p = Params(turns=100, threads=8, image_width=64, image_height=64)
+    board = pgm.read_board(p, REPO_ROOT / "images")
+    res = service.run(
+        Request(world=board, turns=100, image_width=64, image_height=64, threads=8)
+    )
+    assert isinstance(backend._plane_for(64, 64), ShardedBitPlane)
+    assert res.alive == []  # Run's reply ships the world, never the cells
+    expected = read_alive_cells(REPO_ROOT / "check" / "images" / "64x64x100.pgm")
+    assert res.alive_count == len(expected)
+    assert_equal_board(alive_cells(res.world), expected, 64, 64)
+
+
+# -- worker-count sweep (the reference's threads 1..16 matrix,
+# gol_test.go:14-31, against the remainder split rpc/broker.py:_split) -------
+
+
+@pytest.fixture(scope="module")
+def five_worker_cluster():
+    """Five workers + a workers-backend broker; threads= selects how many
+    strips the broker actually scatters."""
+    workers = [
+        _spawn("gol_distributed_final_tpu.rpc.worker", "-port", "0")
+        for _ in range(5)
+    ]
+    broker = None
+    try:
+        ports = [_wait_listening(w) for w in workers]
+        addrs = ",".join(f"127.0.0.1:{p}" for p in ports)
+        broker = _spawn(
+            "gol_distributed_final_tpu.rpc.broker",
+            "-port", "0", "-backend", "workers", "-workers", addrs,
+        )
+        yield f"127.0.0.1:{_wait_listening(broker)}"
+    finally:
+        for p in (*workers, *([broker] if broker else [])):
+            if p.poll() is None:
+                p.kill()
+            p.wait()
+
+
+@pytest.mark.parametrize("threads", [1, 2, 3, 4, 5])
+def test_worker_count_sweep_golden(five_worker_cluster, threads, tmp_path):
+    """64 rows over 1..5 workers: even splits (1, 2, 4) and remainder splits
+    (3 -> 22/21/21, 5 -> 13/13/13/13/12), all golden-exact."""
+    result, _ = _run_remote(
+        five_worker_cluster, 64, 100, tmp_path, threads=threads
+    )
+    expected = read_alive_cells(REPO_ROOT / "check" / "images" / "64x64x100.pgm")
+    assert_equal_board(result.alive, expected, 64, 64)
+
+
+def test_worker_count_sweep_16_golden(five_worker_cluster, tmp_path):
+    """The 16-row board over 5 workers (16 = 5*3 + 1: remainder split with
+    4/3/3/3/3 strips), golden-exact."""
+    result, _ = _run_remote(five_worker_cluster, 16, 100, tmp_path, threads=5)
+    expected = read_alive_cells(REPO_ROOT / "check" / "images" / "16x16x100.pgm")
+    assert_equal_board(result.alive, expected, 16, 16)
+
+
+def test_more_workers_than_rows(five_worker_cluster):
+    """A 4-row board with 5 connected workers exercises plan()'s n = min(...,
+    h) clamp (rpc/broker.py): only 4 single-row strips are scattered, and the
+    result matches the independent numpy oracle."""
+    from oracle import vector_step
+
+    rng = np.random.default_rng(7)
+    world = np.where(rng.random((4, 32)) < 0.4, 255, 0).astype(np.uint8)
+    want = world
+    for _ in range(10):
+        want = vector_step(want)
+    p = Params(turns=10, threads=5, image_width=32, image_height=4)
+    remote = RemoteBroker(five_worker_cluster)
+    try:
+        result = remote.run(p, world)
+    finally:
+        remote.close()
+    assert result.turns_completed == 10
+    np.testing.assert_array_equal(result.world, want)
