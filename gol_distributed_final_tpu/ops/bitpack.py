@@ -238,6 +238,30 @@ def bit_step_n(
     )
 
 
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
+def alive_history(
+    packed,
+    n: int,
+    word_axis: int = 0,
+    birth_mask: int = CONWAY_BIRTH_MASK,
+    survive_mask: int = CONWAY_SURVIVE_MASK,
+):
+    """Per-turn alive counts for turns 1..n in ONE dispatch.
+
+    ``lax.scan`` steps the bitboard and popcounts every state on device, so
+    validating the reference's strictest fixture — every line of the 10k-turn
+    ``check/alive/*.csv`` goldens (count_test.go:45-51) — costs one dispatch
+    and an [n]-int32 transfer instead of n round-trips."""
+    def body(state, _):
+        nxt = bit_step(
+            state, word_axis, birth_mask=birth_mask, survive_mask=survive_mask
+        )
+        return nxt, jnp.sum(lax.population_count(nxt))
+
+    _, counts = lax.scan(body, packed, None, length=n)
+    return counts
+
+
 def packed_step_n_fn(word_axis: int = 0, rule=None):
     """Engine-compatible ``(board_uint8, n) -> board_uint8``: pack, evolve
     on the bitboard, unpack — all on-device, no host round-trips."""

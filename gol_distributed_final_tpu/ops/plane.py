@@ -24,6 +24,14 @@ import numpy as np
 
 from ..models import CONWAY, LifeRule
 
+# shape -> whether the whole-board VMEM kernel actually compiled+ran for it.
+# fits_vmem's working-set factor is a single-point measurement
+# (ops/pallas_stencil.py:_WORKING_SET_FACTOR); shapes near the boundary are
+# one compiler version away from a Mosaic OOM at compile time, so the first
+# failure for a shape routes it to the tiled/XLA path instead of crashing,
+# and the decision is cached so the compile is never re-attempted.
+_VMEM_KERNEL_OK: dict = {}
+
 
 class BytePlane:
     """The identity representation: a device uint8 {0,255} board.
@@ -87,17 +95,27 @@ class BitPlane:
         return pack_device(jnp.asarray(board), self.word_axis)
 
     def step_n(self, state, n: int):
+        from . import pallas_stencil
         from .bitpack import bit_step_n
-        from .pallas_stencil import _bit_compiled, fits_vmem
         from .pallas_tiled import can_tile, tiled_bit_step_n_fn
 
         n = int(n)
         birth, survive = self.rule.birth_mask, self.rule.survive_mask
-        if fits_vmem(state.shape, itemsize=4):
-            return _bit_compiled(n, self.word_axis, self.interpret, birth, survive)(
-                state
-            )
-        if not self.interpret and self.word_axis == 0 and can_tile(state.shape):
+        shape = tuple(state.shape)
+        if pallas_stencil.fits_vmem(shape, itemsize=4) and _VMEM_KERNEL_OK.get(
+            shape, True
+        ):
+            try:
+                out = pallas_stencil._bit_compiled(
+                    n, self.word_axis, self.interpret, birth, survive
+                )(state)
+                _VMEM_KERNEL_OK[shape] = True
+                return out
+            except Exception:
+                if _VMEM_KERNEL_OK.get(shape):
+                    raise  # this shape compiled before: a real runtime error
+                _VMEM_KERNEL_OK[shape] = False  # mis-calibrated gate: fall back
+        if not self.interpret and self.word_axis == 0 and can_tile(shape):
             return tiled_bit_step_n_fn(rule=self.rule, interpret=False)(state, n)
         return bit_step_n(state, n, self.word_axis, birth, survive)
 

@@ -93,6 +93,37 @@ def test_alive_counts_match_golden_csv(tmp_path):
     assert len(quits) == 2  # one from 'q', one from the closing sequence
 
 
+def _csv_sweep(size: int):
+    """Every per-turn alive count for turns 1..10000 must equal the golden
+    CSV line — the reference's strictest fixture, validated in full
+    (count_test.go:45-51 checks every reported count against the CSV; here
+    we check EVERY turn, not just the ones a ticker lands on)."""
+    from gol_distributed_final_tpu.io.pgm import read_pgm
+    from gol_distributed_final_tpu.ops.bitpack import alive_history, pack
+
+    counts = read_alive_counts(
+        REPO_ROOT / "check" / "alive" / f"{size}x{size}.csv"
+    )
+    turns = max(counts)
+    assert turns == 10_000
+    packed = pack(read_pgm(REPO_ROOT / "images" / f"{size}x{size}.pgm"))
+    got = np.asarray(alive_history(packed, turns))
+    want = np.array([counts[t] for t in range(1, turns + 1)], got.dtype)
+    mismatch = np.nonzero(got != want)[0]
+    assert mismatch.size == 0, (
+        f"first mismatch at turn {mismatch[0] + 1}: "
+        f"got {got[mismatch[0]]}, want {want[mismatch[0]]}"
+    )
+
+
+def test_full_10k_sweep_64():
+    _csv_sweep(64)
+
+
+def test_full_10k_sweep_512():
+    _csv_sweep(512)
+
+
 def test_first_report_within_liveness_bound(tmp_path):
     """First AliveCellsCount must arrive within 5 s of start
     (count_test.go:30-38) even on a large board: chunking must not let a

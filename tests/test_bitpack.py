@@ -158,3 +158,41 @@ def test_engine_auto_fast_golden(tmp_path):
             if isinstance(ev, FinalTurnComplete):
                 final = ev
         assert_equal_board(final.alive, expected, 64, 64)
+
+
+def test_vmem_gate_falls_back_on_compile_failure(monkeypatch):
+    """If the whole-board VMEM kernel fails at compile/call time (the
+    fits_vmem working-set factor is a measured heuristic — a board near the
+    boundary can OOM under a new compiler), BitPlane.step_n must fall back
+    to a correct path and cache the decision instead of crashing."""
+    from gol_distributed_final_tpu.ops import plane as plane_mod
+    from gol_distributed_final_tpu.ops.plane import BitPlane
+
+    calls = {"n": 0}
+
+    def exploding_compile(*args, **kwargs):
+        calls["n"] += 1
+
+        def run(packed):
+            raise RuntimeError("Mosaic: RESOURCE_EXHAUSTED: VMEM allocation")
+
+        return run
+
+    from gol_distributed_final_tpu.ops import pallas_stencil
+
+    monkeypatch.setattr(pallas_stencil, "_bit_compiled", exploding_compile)
+    monkeypatch.setattr(plane_mod, "_VMEM_KERNEL_OK", {})
+
+    board = random_board(64, 64, seed=5)
+    plane = BitPlane(word_axis=0)
+    state = plane.encode(board)
+    got = plane.decode(plane.step_n(state, 7))
+    want = board
+    for _ in range(7):
+        want = vector_step(want)
+    np.testing.assert_array_equal(got, want)
+    assert calls["n"] == 1
+
+    # the failure is cached per shape: the second call skips the attempt
+    plane.step_n(state, 3)
+    assert calls["n"] == 1
