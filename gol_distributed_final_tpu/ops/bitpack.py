@@ -202,6 +202,11 @@ def bit_step(
     def eq(value: int):
         acc = None
         for bit, plane in enumerate(planes):
+            # the weight-8 plane only separates T in {8, 9} from {0, 1}:
+            # for a target in 2..7 the aliasing value (target + 8 > 9) is
+            # unreachable, so the ~p3 term is dead weight on the hot path
+            if bit == 3 and 2 <= value <= 7:
+                continue
             term = plane if value >> bit & 1 else ~plane
             acc = term if acc is None else acc & term
         return acc
@@ -213,10 +218,26 @@ def bit_step(
         return acc
 
     dead_ts, live_ts = _rule_planes(birth_mask, survive_mask)
-    zero = packed ^ packed  # a zero of the right dtype/shape
-    born = any_eq(dead_ts) if dead_ts else zero
-    kept = any_eq(live_ts) if live_ts else zero
-    return (~mid & born) | (mid & kept)
+    # Hoist the shared T-values out of the mid-select: with D = dead-only,
+    # L = live-only, C = common, the select (~m & (C|D)) | (m & (C|L))
+    # simplifies to C | (~m & D) | (m & L) — for Conway (C={3}, D={},
+    # L={4}) that is eq(3) | (mid & eq(4)), the minimal form.
+    common = sorted(set(dead_ts) & set(live_ts))
+    dead_only = [t for t in dead_ts if t not in common]
+    live_only = [t for t in live_ts if t not in common]
+    terms = []
+    if common:
+        terms.append(any_eq(common))
+    if dead_only:
+        terms.append(~mid & any_eq(dead_only))
+    if live_only:
+        terms.append(mid & any_eq(live_only))
+    if not terms:
+        return packed ^ packed  # a zero of the right dtype/shape
+    out = terms[0]
+    for t in terms[1:]:
+        out = out | t
+    return out
 
 
 @functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))

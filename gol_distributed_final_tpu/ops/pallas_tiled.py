@@ -8,20 +8,41 @@ XLA materialises the ~10 bit-plane intermediates of ``bit_step`` in HBM
 once the working set stops fitting on-chip (measured 617 us/turn vs the
 ~80 us floor of read+write 2x32 MiB at ~800 GB/s).
 
-This kernel restores most of it: the packed array is processed in row
-blocks; each grid step sees three views of the SAME array — the previous,
-own, and next block (index maps offset by +-1 modulo the grid, so torus
-wrap falls out of the index arithmetic; Mosaic requires sublane-aligned
-block shapes, which rules out 1-row halo blocks) — and extends its body
-with just the neighbours' edge word-rows (the full bit_step dependency:
-output word (i, j) depends only on words (i+-1, j+-1); column wrap is a
-lane rotate inside the block, which spans the full width). Per turn, HBM
-traffic is ~3x read + 1x write of the packed board, pipelined against
-compute — the bit-plane temporaries (the XLA path's downfall) stay in
-VMEM.
+This kernel runs at ~1x read + 1x write of the packed board per turn. The
+array is processed on a 2-D grid of (block_rows x block_cols) blocks; each
+grid step sees NINE views of the SAME array — its own block plus the
+EDGES of the eight neighbours: 8-sublane word-row strips above/below,
+128-lane word-column strips left/right, and (8, 128) corners (Mosaic
+block shapes must be sublane(8)/lane(128)-aligned, which is why the halos
+cannot be single word-rows). The kernel concatenates the tiles into a
+fully tile-aligned (pb+16, wb+256) extended window of the torus — only
+the innermost word-row/-column of each halo tile actually feeds the
+``bit_step`` dependency (output word (i, j) reads words (i+-1, j+-1));
+the rest buys alignment — steps it, and writes back the interior.
+Neighbour indices wrap modulo the grid, so torus wrap falls out of the
+index arithmetic. Per turn, HBM traffic is
+
+    (1 + 16/pb + 256/wb + corners) x read + 1x write
+
+~1.25x read at the default (128, 2048) block vs the previous full-block
+scheme's 3x — and, unlike the round-2 kernel whose blocks spanned the full
+board width, the lane axis splits too, so a 65536^2 board (packed row =
+256 KiB) tiles with the same bounded VMEM working set as any other size.
+
+The bit-plane temporaries of ``bit_step`` (the XLA path's downfall) live
+in VMEM over one (pb+16, wb+256) ext: ~12x block bytes of working set,
+double-buffered pipeline included, against the ~16 MiB budget.
 
 All ``n`` turns run in ONE jitted dispatch (lax.fori_loop around the
 pallas_call), one kernel launch per turn.
+
+Measured at 16384^2 on v5e: 126-130 us/turn (round 2's full-block scheme:
+138). The limit is NOT HBM (~75 us of traffic at these blocks) but the
+VPU compute roofline: ~39 bitwise ops/word x 1.27 halo-overhead x 8.4M
+words at ~4e12 int32 ops/s is ~115 us — the kernel runs within ~10% of
+that. Multi-turn-per-launch variants (amortising halo DMA over up to 127
+turns of in-VMEM evolution) measured SLOWER (~165 us/turn): the in-kernel
+fori_loop defeats Mosaic's pipelining, so the single-turn form stands.
 """
 
 from __future__ import annotations
@@ -35,46 +56,90 @@ from jax import lax
 from .bitpack import bit_step
 from .stencil import CONWAY_BIRTH_MASK, CONWAY_SURVIVE_MASK
 
-# per-block VMEM footprint target: body + 2 halo rows + out + temporaries,
-# double-buffered by the pipeline. 512 KiB blocks keep the working set
-# comfortably inside ~16 MiB VMEM.
-_BLOCK_BYTES_TARGET = 512 * 1024
+# Body-block byte budget. Working set per grid step is ~12x block bytes
+# (ext + ~10 bit-plane temporaries + double-buffered in/out). Measured on
+# v5e: 1 MiB blocks compile and run, 2 MiB blocks fail Mosaic allocation —
+# and larger blocks shrink the halo-overhead fraction, so target the
+# largest size that fits.
+_BLOCK_BYTES_TARGET = 1024 * 1024
+
+_SUBLANE = 8  # int32 sublane tile: min rows of any block
+_LANE = 128  # lane tile: min cols of any block
 
 
 def can_tile(shape: tuple[int, int]) -> bool:
-    """Mosaic block shapes must be sublane(8)-aligned: the packed row count
-    must factor into 8-row blocks with more than one block."""
-    return shape[0] % 8 == 0 and shape[0] // 8 >= 2
+    """Mosaic block shapes must be sublane(8)/lane(128)-aligned: the packed
+    row count must factor into 8-row blocks with more than one block, and
+    the width into 128-lane blocks."""
+    return shape[0] % _SUBLANE == 0 and shape[0] // _SUBLANE >= 2 and shape[1] % _LANE == 0
 
 
-def _pick_block_rows(packed_rows: int, width: int) -> int:
-    """Largest multiple-of-8 divisor of ``packed_rows`` with block bytes
-    <= target (minimum 8 — the int32 sublane tile)."""
-    limit = max(8, _BLOCK_BYTES_TARGET // (width * 4))
-    divisors = [
-        d
-        for d in range(8, packed_rows, 8)
-        if packed_rows % d == 0 and d <= limit
-    ]
-    return max(divisors) if divisors else 8
+def _aligned_divisors(n: int, align: int):
+    return [d for d in range(align, n + 1, align) if n % d == 0]
+
+
+def _pick_blocks(rows: int, width: int) -> tuple[int, int]:
+    """Choose (block_rows, block_cols) minimising halo read overhead
+    (8/pb + 128/wb) subject to the block byte budget.
+
+    An (8, 128) block always qualifies (4 KiB), so any `can_tile` shape
+    gets a valid choice — the round-2 scheme's failure mode (full-width
+    blocks exceeding VMEM on very wide boards) cannot occur."""
+    best = None
+    for pb in _aligned_divisors(rows, _SUBLANE):
+        for wb in _aligned_divisors(width, _LANE):
+            if pb * wb * 4 > _BLOCK_BYTES_TARGET:
+                break  # wb ascending: larger ones only get bigger
+            overhead = _SUBLANE / pb + _LANE / wb
+            key = (overhead, -pb * wb)
+            if best is None or key < best[0]:
+                best = (key, (pb, wb))
+    assert best is not None, (rows, width)
+    return best[1]
+
+
+def _validate_block(name: str, val: int, total: int, align: int) -> None:
+    if val % align or total % val:
+        raise ValueError(
+            f"{name}={val} must be a multiple of {align} dividing {total}"
+        )
 
 
 def _tiled_kernel(
-    top_ref, body_ref, bot_ref, out_ref, *, birth_mask, survive_mask, interpret
+    tl_ref,
+    top_ref,
+    tr_ref,
+    left_ref,
+    body_ref,
+    right_ref,
+    bl_ref,
+    bot_ref,
+    br_ref,
+    out_ref,
+    *,
+    birth_mask,
+    survive_mask,
+    interpret,
 ):
-    # only the neighbours' edge word-rows extend the body: temporaries
-    # scale with (pb + 2) rows, not 3*pb
-    ext = jnp.concatenate(
-        [top_ref[-1:, :], body_ref[:], bot_ref[:1, :]], axis=0
-    )
+    # The halo blocks are full (8, .) / (., 128) tiles — genuine board
+    # windows, not just the single adjacent word-row/-column — so the
+    # extended block stays sublane/lane ALIGNED: every rotate inside
+    # bit_step is a native tile-aligned op (a (pb+2, wb+2) ext measured
+    # ~2.5x slower from Mosaic's unaligned-lane handling). Temporaries
+    # scale with (pb+16)(wb+256), ~1.4x the body, not 3x.
+    top = jnp.concatenate([tl_ref[:], top_ref[:], tr_ref[:]], axis=1)
+    mid = jnp.concatenate([left_ref[:], body_ref[:], right_ref[:]], axis=1)
+    bot = jnp.concatenate([bl_ref[:], bot_ref[:], br_ref[:]], axis=1)
+    ext = jnp.concatenate([top, mid, bot], axis=0)
     from .pallas_stencil import pick_rot1
 
     rot1 = pick_rot1(interpret)
-    # cyclic rotates only contaminate ext's outer rows, which are sliced
+    # cyclic rotates only contaminate ext's outer ring, well clear of the
+    # interior slice
     out = bit_step(
         ext, 0, rot1, birth_mask=birth_mask, survive_mask=survive_mask
     )
-    out_ref[:] = out[1:-1]
+    out_ref[:] = out[_SUBLANE:-_SUBLANE, _LANE:-_LANE]
 
 
 @functools.lru_cache(maxsize=None)
@@ -85,12 +150,37 @@ def _tiled_compiled(
     birth_mask: int = CONWAY_BIRTH_MASK,
     survive_mask: int = CONWAY_SURVIVE_MASK,
     block_rows: int | None = None,
+    block_cols: int | None = None,
 ):
     from jax.experimental import pallas as pl
 
     rows, width = shape
-    pb = block_rows or _pick_block_rows(rows, width)
-    grid = rows // pb
+    auto = (
+        _pick_blocks(rows, width) if not (block_rows and block_cols) else None
+    )
+    pb = block_rows or auto[0]
+    wb = block_cols or auto[1]
+    _validate_block("block_rows", pb, rows, _SUBLANE)
+    _validate_block("block_cols", wb, width, _LANE)
+    gr, gc = rows // pb, width // wb
+    rsub, csub = pb // _SUBLANE, wb // _LANE  # sublane/lane tiles per block
+
+    # Index maps are in BLOCK units of each spec's own block shape. Edge
+    # blocks address the neighbour's boundary tile; modulo wraps the torus
+    # (including the degenerate single-block-per-axis grids, where the
+    # neighbour is the block itself).
+    def up(i):  # topmost 8-row tile of the row-block above
+        return ((i - 1) % gr) * rsub + rsub - 1
+
+    def down(i):
+        return ((i + 1) % gr) * rsub
+
+    def lft(j):
+        return ((j - 1) % gc) * csub + csub - 1
+
+    def rgt(j):
+        return ((j + 1) % gc) * csub
+
     kernel = functools.partial(
         _tiled_kernel,
         birth_mask=birth_mask,
@@ -99,21 +189,28 @@ def _tiled_compiled(
     )
     one_turn = pl.pallas_call(
         kernel,
-        grid=(grid,),
+        grid=(gr, gc),
         in_specs=[
-            # previous, own, next block of the same array; modulo wraps
-            pl.BlockSpec((pb, width), lambda i: ((i - 1) % grid, 0)),
-            pl.BlockSpec((pb, width), lambda i: (i, 0)),
-            pl.BlockSpec((pb, width), lambda i: ((i + 1) % grid, 0)),
+            pl.BlockSpec((_SUBLANE, _LANE), lambda i, j: (up(i), lft(j))),
+            pl.BlockSpec((_SUBLANE, wb), lambda i, j: (up(i), j)),
+            pl.BlockSpec((_SUBLANE, _LANE), lambda i, j: (up(i), rgt(j))),
+            pl.BlockSpec((pb, _LANE), lambda i, j: (i, lft(j))),
+            pl.BlockSpec((pb, wb), lambda i, j: (i, j)),
+            pl.BlockSpec((pb, _LANE), lambda i, j: (i, rgt(j))),
+            pl.BlockSpec((_SUBLANE, _LANE), lambda i, j: (down(i), lft(j))),
+            pl.BlockSpec((_SUBLANE, wb), lambda i, j: (down(i), j)),
+            pl.BlockSpec((_SUBLANE, _LANE), lambda i, j: (down(i), rgt(j))),
         ],
-        out_specs=pl.BlockSpec((pb, width), lambda i: (i, 0)),
+        out_specs=pl.BlockSpec((pb, wb), lambda i, j: (i, j)),
         out_shape=jax.ShapeDtypeStruct(shape, jnp.int32),
         interpret=interpret,
     )
 
     @jax.jit
     def run(packed):
-        return lax.fori_loop(0, n, lambda _, p: one_turn(p, p, p), packed)
+        return lax.fori_loop(
+            0, n, lambda _, p: one_turn(p, p, p, p, p, p, p, p, p), packed
+        )
 
     return run
 
@@ -123,11 +220,12 @@ def tiled_bit_step_n_fn(
     interpret: bool | None = None,
     rule=None,
     block_rows: int | None = None,
+    block_cols: int | None = None,
 ):
     """A ``(packed_int32 [P, W], n) -> packed`` for word_axis=0 bitboards of
     any size: n turns in one dispatch, one grid-tiled kernel launch per
-    turn, ~BW-floor HBM traffic. Row-packed layout only (the layout every
-    large-board path uses — lanes stay W wide)."""
+    turn, ~BW-floor HBM traffic (edge-only halo reads). Row-packed layout
+    only (the layout every large-board path uses — lanes stay W wide)."""
     birth = rule.birth_mask if rule else CONWAY_BIRTH_MASK
     survive = rule.survive_mask if rule else CONWAY_SURVIVE_MASK
     if interpret is None:
@@ -135,7 +233,7 @@ def tiled_bit_step_n_fn(
 
     def step_n(packed, n):
         return _tiled_compiled(
-            int(n), packed.shape, interpret, birth, survive, block_rows
+            int(n), packed.shape, interpret, birth, survive, block_rows, block_cols
         )(packed)
 
     return step_n

@@ -17,7 +17,8 @@ import jax.numpy as jnp
 from gol_distributed_final_tpu.models import HIGHLIFE
 from gol_distributed_final_tpu.ops import bitpack
 from gol_distributed_final_tpu.ops.pallas_tiled import (
-    _pick_block_rows,
+    _BLOCK_BYTES_TARGET,
+    _pick_blocks,
     can_tile,
     tiled_bit_step_n_fn,
 )
@@ -35,9 +36,53 @@ def test_can_tile_and_block_choice():
     assert can_tile((16, 512))  # 512^2 packed: two 8-row blocks
     assert not can_tile((8, 256))  # single block: nothing to tile
     assert not can_tile((12, 384))  # not sublane-divisible
-    assert _pick_block_rows(512, 16384) == 8  # 512 KiB cap
-    assert _pick_block_rows(128, 4096) * 4096 * 4 <= 512 * 1024
-    assert _pick_block_rows(128, 4096) % 8 == 0
+    assert not can_tile((16, 192))  # width not lane(128)-divisible
+    for rows, width in [(512, 16384), (128, 4096), (2048, 65536), (16, 512)]:
+        pb, wb = _pick_blocks(rows, width)
+        assert pb % 8 == 0 and rows % pb == 0
+        assert wb % 128 == 0 and width % wb == 0
+        assert pb * wb * 4 <= _BLOCK_BYTES_TARGET
+    # the ADVICE round-2 failure shape: 65536^2 packed rows are 256 KiB
+    # wide, so the block MUST split the lane axis to bound VMEM
+    pb, wb = _pick_blocks(2048, 65536)
+    assert wb < 65536
+
+
+def test_invalid_block_shape_raises():
+    packed = bitpack.pack_device(jnp.asarray(random_board(512, 256)), 0)
+    with pytest.raises(ValueError, match="block_rows"):
+        tiled_bit_step_n_fn(interpret=True, block_rows=12)(packed, 1)
+    with pytest.raises(ValueError, match="block_rows"):
+        # multiple of 8 but does not divide the 16 packed rows: would
+        # silently evolve a truncated board if accepted
+        tiled_bit_step_n_fn(interpret=True, block_rows=48)(packed, 1)
+    with pytest.raises(ValueError, match="block_cols"):
+        tiled_bit_step_n_fn(interpret=True, block_cols=192)(packed, 1)
+
+
+def test_tiled_2d_grid_matches_xla_bitboard():
+    """Blocks split along BOTH axes (grid 2x2, forced small blocks):
+    column-halo and corner fetches must reproduce the XLA bitboard."""
+    board = random_board(512, 256, seed=11)
+    packed = bitpack.pack_device(jnp.asarray(board), 0)  # [16, 256]
+    tiled = tiled_bit_step_n_fn(interpret=True, block_rows=8, block_cols=128)
+    got = tiled(packed, 5)
+    want = bitpack.bit_step_n(packed, 5, 0)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_tiled_glider_wraps_through_column_blocks():
+    """A glider moving diagonally crosses every block-column boundary and
+    both torus edges; 2-D modulo index maps must bring it home."""
+    board = np.zeros((512, 256), np.uint8)
+    for x, y in [(1, 0), (2, 1), (0, 2), (1, 2), (2, 2)]:
+        board[y, x] = 255
+    packed = bitpack.pack_device(jnp.asarray(board), 0)  # [16, 256], grid 2x2
+    tiled = tiled_bit_step_n_fn(interpret=True, block_rows=8, block_cols=128)
+    out = tiled(packed, 4 * 512)  # H down + H right; H % W == 0 => home
+    np.testing.assert_array_equal(
+        np.asarray(bitpack.unpack_device(out, 0)), board
+    )
 
 
 @pytest.mark.parametrize("turns", [1, 7])
