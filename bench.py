@@ -49,6 +49,7 @@ BASELINE_CELL_UPDATES_PER_SEC = 50 * 512 * 512  # documented estimate, see above
 GOLDEN_512 = {1000: 6444, 10000: 5565}  # check/alive/512x512.csv
 STEADY_512 = {0: 5565, 1: 5567}  # period-2 steady state beyond turn 10000
 REPS = 5
+NOISE_MARGIN = 5  # marginal work must exceed endpoint spread by this factor
 
 
 def oracle_step_n(board, n):
@@ -68,12 +69,23 @@ def oracle_step_n(board, n):
     return (b * 255).astype(np.uint8)
 
 
-def marginal(time_fn, n_lo, n_hi):
+class InvalidMeasurement(RuntimeError):
+    """A fit that must not be published (non-positive or noise-dominated)."""
+
+
+def marginal(time_fn, n_lo, n_hi, label="?"):
     """Per-run-unit marginal cost between n_lo and n_hi, with variance.
 
     Returns (per_turn_seconds, details): endpoints are min over REPS; the
     details dict records min/median/spread per endpoint and the fixed
-    overhead implied by the linear fit."""
+    overhead implied by the linear fit.
+
+    Raises InvalidMeasurement — the round-2 c5 entry published a NEGATIVE
+    throughput because the 1000-turn marginal work (~3 ms) was buried
+    under ~2 s of per-call transfer overhead with +-1 s spread — if the
+    fit is non-positive, or if the marginal work does not dominate the
+    endpoint noise by at least NOISE_MARGIN. Callers must widen the
+    endpoints (or cut per-call transfers) rather than publish garbage."""
 
     def sample(n):
         times = []
@@ -85,6 +97,9 @@ def marginal(time_fn, n_lo, n_hi):
 
     lo, hi = sample(n_lo), sample(n_hi)
     per_turn = (min(hi) - min(lo)) / (n_hi - n_lo)
+    spread = max(
+        statistics.median(lo) - min(lo), statistics.median(hi) - min(hi)
+    )
     details = {
         "n_lo": n_lo,
         "n_hi": n_hi,
@@ -100,6 +115,16 @@ def marginal(time_fn, n_lo, n_hi):
             5,
         ),
     }
+    marginal_work = min(hi) - min(lo)
+    if per_turn <= 0:
+        raise InvalidMeasurement(
+            f"{label}: non-positive fit {per_turn * 1e6:.2f} us/turn — {details}"
+        )
+    if marginal_work < NOISE_MARGIN * spread:
+        raise InvalidMeasurement(
+            f"{label}: marginal work {marginal_work:.4f}s does not dominate "
+            f"endpoint spread {spread:.4f}s (need {NOISE_MARGIN}x) — {details}"
+        )
     return per_turn, details
 
 
@@ -144,7 +169,7 @@ def main() -> int:
         if alive != STEADY_512[n % 2]:
             print(f"STEADY-STATE FAILURE at {n}: {alive}", file=sys.stderr)
             return 1
-    per_turn, det = marginal(evolve, n_lo, n_hi)
+    per_turn, det = marginal(evolve, n_lo, n_hi, "c3_512_pallas_bitboard")
     headline = 512 * 512 / per_turn
     extra["c3_512_pallas_bitboard"] = dict(det, cell_updates_per_s=round(headline))
 
@@ -165,7 +190,7 @@ def main() -> int:
         print(f"ENGINE PARITY FAILURE: {alive}", file=sys.stderr)
         return 1
     engine_run(n_lo), engine_run(n_hi)  # warm both endpoint shapes
-    eng_per_turn, eng_det = marginal(engine_run, n_lo, n_hi)
+    eng_per_turn, eng_det = marginal(engine_run, n_lo, n_hi, "c3_512_engine_driven")
     extra["c3_512_engine_driven"] = dict(
         eng_det,
         cell_updates_per_s=round(512 * 512 / eng_per_turn),
@@ -186,7 +211,7 @@ def main() -> int:
         return 1
     print("parity 128^2 ok (1000 turns vs numpy oracle)", file=sys.stderr)
     evolve128(n_lo), evolve128(n_hi)
-    pt128, det128 = marginal(evolve128, n_lo, n_hi)
+    pt128, det128 = marginal(evolve128, n_lo, n_hi, "c2_128_pallas_bitboard")
     extra["c2_128_pallas_bitboard"] = dict(
         det128, cell_updates_per_s=round(128 * 128 / pt128)
     )
@@ -206,36 +231,44 @@ def main() -> int:
     print("parity 4096^2 ok (100 turns vs roll stencil)", file=sys.stderr)
 
     def evolve4k(n):
-        return np.asarray(plane.step_n(state, n))
+        # popcount sync: timed calls never transfer the packed state
+        return bitpack.alive_count_packed(plane.step_n(state, n))
 
     n4_lo, n4_hi = 2_000, 12_000  # config-4 scale: 10k turns
     evolve4k(n4_lo), evolve4k(n4_hi)
-    pt4k, det4k = marginal(evolve4k, n4_lo, n4_hi)
+    pt4k, det4k = marginal(evolve4k, n4_lo, n4_hi, "c4_4096_tiled_bitboard")
     extra["c4_4096_tiled_bitboard"] = dict(
         det4k, cell_updates_per_s=round(4096 * 4096 / pt4k)
     )
 
-    # ---- config 5 shape: 16384^2 sparse, streamed big-board path ---------
+    # ---- config 5: 65536^2 sparse (THE BASELINE scale), 16384^2 waypoint --
+    # The board exists only as a packed bitboard on device (512 MiB at
+    # 65536^2), evolved by the grid-tiled pallas kernel. Timed calls sync
+    # through a device-side popcount — a handful of KiB across the tunnel —
+    # NOT a full-state transfer (the round-2 mistake: 32 MiB per call put
+    # ~2 s +-1 s of noise around ~3 ms of marginal work and published a
+    # negative throughput).
     from gol_distributed_final_tpu.bigboard import r_pentomino, seed_packed
 
-    state16k = seed_packed(16384, r_pentomino(16384))
-    plane16k = BitPlane(CONWAY, word_axis)
-    # device-side popcount: the 16384^2 board stays packed on device
-    alive = bitpack.alive_count_packed(plane16k.step_n(state16k, 1000))
-    if alive != 156:  # oracle-validated (tests/test_bigboard.py methodology)
-        print(f"PARITY FAILURE 16384^2: {alive} != 156", file=sys.stderr)
-        return 1
-    print("parity 16384^2 ok (R-pentomino, 1000 turns)", file=sys.stderr)
+    for size, key in ((16384, "c5_16384_sparse_bigboard"), (65536, "c5_65536_sparse_bigboard")):
+        state_big = seed_packed(size, r_pentomino(size))
+        plane_big = BitPlane(CONWAY, word_axis)
+        alive = bitpack.alive_count_packed(plane_big.step_n(state_big, 1000))
+        if alive != 156:  # oracle-validated (tests/test_bigboard.py methodology)
+            print(f"PARITY FAILURE {size}^2: {alive} != 156", file=sys.stderr)
+            return 1
+        print(f"parity {size}^2 ok (R-pentomino, 1000 turns)", file=sys.stderr)
 
-    def evolve16k(n):
-        return np.asarray(plane16k.step_n(state16k, n))
+        def evolve_big(n, state_big=state_big, plane_big=plane_big):
+            return bitpack.alive_count_packed(plane_big.step_n(state_big, n))
 
-    n5_lo, n5_hi = 200, 1_200
-    evolve16k(n5_lo), evolve16k(n5_hi)
-    pt16k, det16k = marginal(evolve16k, n5_lo, n5_hi)
-    extra["c5_16384_sparse_bigboard"] = dict(
-        det16k, cell_updates_per_s=round(16384 * 16384 / pt16k)
-    )
+        n5_lo, n5_hi = (2_000, 22_000) if size == 16384 else (500, 3_500)
+        evolve_big(n5_lo), evolve_big(n5_hi)
+        pt_big, det_big = marginal(evolve_big, n5_lo, n5_hi, key)
+        extra[key] = dict(det_big, cell_updates_per_s=round(size * size / pt_big))
+        # drop BOTH references (the closure's default-arg binding keeps the
+        # device buffer alive otherwise) so the 512 MiB frees between sizes
+        del evolve_big, state_big
 
     print(
         json.dumps(

@@ -1,48 +1,51 @@
 """Grid-tiled pallas bitboard kernel — the fast path for boards whose
-packed form exceeds VMEM.
+packed form exceeds the whole-board VMEM gate (ops/pallas_stencil.py,
+packed <= ~1.5 MiB). Before round 2 the fallback was the XLA bitboard
+step, which at 16384^2 runs ~5x slower: XLA materialises the ~10
+bit-plane intermediates of ``bit_step`` in HBM once the working set stops
+fitting on-chip.
 
-The whole-board VMEM kernel (ops/pallas_stencil.py) tops out at packed
-<= ~1.5 MiB (measured; fits_vmem). Beyond that, round 2's fallback was the
-XLA bitboard step, which at 16384^2 runs ~8x above the HBM-bandwidth floor:
-XLA materialises the ~10 bit-plane intermediates of ``bit_step`` in HBM
-once the working set stops fitting on-chip (measured 617 us/turn vs the
-~80 us floor of read+write 2x32 MiB at ~800 GB/s).
+The kernel processes the packed array block by block; each grid step
+extends its block with halo data from the neighbouring blocks (wrapping
+modulo the grid, so torus wrap falls out of the index arithmetic), steps
+the extended window with ``bit_step`` — whose bit-plane temporaries stay
+in VMEM — and writes back the interior. All ``n`` turns run in ONE jitted
+dispatch (lax.fori_loop around the pallas_call), one launch per turn.
 
-This kernel runs at ~1x read + 1x write of the packed board per turn. The
-array is processed on a 2-D grid of (block_rows x block_cols) blocks; each
-grid step sees NINE views of the SAME array — its own block plus the
-EDGES of the eight neighbours: 8-sublane word-row strips above/below,
-128-lane word-column strips left/right, and (8, 128) corners (Mosaic
-block shapes must be sublane(8)/lane(128)-aligned, which is why the halos
-cannot be single word-rows). The kernel concatenates the tiles into a
-fully tile-aligned (pb+16, wb+256) extended window of the torus — only
-the innermost word-row/-column of each halo tile actually feeds the
-``bit_step`` dependency (output word (i, j) reads words (i+-1, j+-1));
-the rest buys alignment — steps it, and writes back the interior.
-Neighbour indices wrap modulo the grid, so torus wrap falls out of the
-index arithmetic. Per turn, HBM traffic is
+Two regimes, chosen by ``_plan`` from the ext byte budget (all measured
+on a real v5e, 2026-07; a third "resident" regime — round 2's full-block
+halos on the theory that small boards stay VMEM-resident between calls —
+measured strictly slower than ``rows`` at every size and was removed):
 
-    (1 + 16/pb + 256/wb + corners) x read + 1x write
+* ``rows`` — boards of moderate width: full-width blocks, 8-row
+  edge-strip halos above/below (Mosaic block shapes must be sublane(8)-
+  aligned, so strips cannot be single word-rows). Reads are contiguous
+  HBM row ranges, (1 + 16/pb)x read + 1x write per turn, ext (pb+16, W).
+  4096^2: 6.8 us/turn vs 7.5-10 for the round-2 full-block scheme
+  re-measured today (its committed 2.95 did not reproduce).
+* ``grid2d`` — boards too wide for a full-width ext to fit VMEM (packed
+  width >= ~8192, e.g. 65536^2 whose packed rows are 256 KiB — the shape
+  that overflowed the round-2 full-width-only scheme): blocks split BOTH
+  axes; each grid step reads its body plus the eight neighbours' edge
+  tiles (8-row/128-lane strips and corners) into a fully tile-aligned
+  (pb+16, wb+256) ext. Keeping the ext tile-aligned matters: a minimal
+  (pb+2, wb+2) ext measured ~2.5x slower from Mosaic's unaligned-lane
+  handling. Column-halo reads are strided, which is why full-width
+  regimes are preferred whenever they fit.
 
-~1.25x read at the default (128, 2048) block vs the previous full-block
-scheme's 3x — and, unlike the round-2 kernel whose blocks spanned the full
-board width, the lane axis splits too, so a 65536^2 board (packed row =
-256 KiB) tiles with the same bounded VMEM working set as any other size.
+Cyclic rotates inside ``bit_step`` only contaminate the ext's outer
+ring, which the interior slice discards; where the ext spans the full
+width, the lane rotate IS the column torus wrap.
 
-The bit-plane temporaries of ``bit_step`` (the XLA path's downfall) live
-in VMEM over one (pb+16, wb+256) ext: ~12x block bytes of working set,
-double-buffered pipeline included, against the ~16 MiB budget.
-
-All ``n`` turns run in ONE jitted dispatch (lax.fori_loop around the
-pallas_call), one kernel launch per turn.
-
-Measured at 16384^2 on v5e: 126-130 us/turn (round 2's full-block scheme:
-138). The limit is NOT HBM (~75 us of traffic at these blocks) but the
-VPU compute roofline: ~39 bitwise ops/word x 1.27 halo-overhead x 8.4M
-words at ~4e12 int32 ops/s is ~115 us — the kernel runs within ~10% of
-that. Multi-turn-per-launch variants (amortising halo DMA over up to 127
-turns of in-VMEM evolution) measured SLOWER (~165 us/turn): the in-kernel
-fori_loop defeats Mosaic's pipelining, so the single-turn form stands.
+Measured at 16384^2 (grid2d (128, 2048)): 128-130 us/turn (round 2's
+full-block scheme: 138). The limit is NOT HBM traffic (~75 us at these
+blocks) but the VPU compute roofline: ~39 bitwise ops/word x 1.27
+halo-overhead x 8.4M words at ~4e12 int32 ops/s is ~115 us — the kernel
+runs within ~10% of that. Multi-turn-per-launch variants (amortising
+halo DMA over up to 127 turns of in-VMEM evolution — the halo tiles are
+256 cell-rows / 128 cell-columns deep) measured SLOWER (~165 us/turn):
+the in-kernel fori_loop defeats Mosaic's pipelining, so the single-turn
+form stands.
 """
 
 from __future__ import annotations
@@ -56,46 +59,30 @@ from jax import lax
 from .bitpack import bit_step
 from .stencil import CONWAY_BIRTH_MASK, CONWAY_SURVIVE_MASK
 
-# Body-block byte budget. Working set per grid step is ~12x block bytes
-# (ext + ~10 bit-plane temporaries + double-buffered in/out). Measured on
-# v5e: 1 MiB blocks compile and run, 2 MiB blocks fail Mosaic allocation —
-# and larger blocks shrink the halo-overhead fraction, so target the
-# largest size that fits.
-_BLOCK_BYTES_TARGET = 1024 * 1024
-
 _SUBLANE = 8  # int32 sublane tile: min rows of any block
 _LANE = 128  # lane tile: min cols of any block
+
+# bit_step keeps ~10 bit-plane temporaries live over the ext; with the
+# double-buffered in/out pipeline the per-step working set is ~12x ext
+# bytes. Measured on v5e: 1.27 MiB exts compile and run, ~2.5 MiB fail
+# Mosaic allocation. Larger blocks shrink the halo-overhead fraction, so
+# target the largest ext that fits.
+_EXT_BYTES_TARGET = 1_340_000
 
 
 def can_tile(shape: tuple[int, int]) -> bool:
     """Mosaic block shapes must be sublane(8)/lane(128)-aligned: the packed
     row count must factor into 8-row blocks with more than one block, and
     the width into 128-lane blocks."""
-    return shape[0] % _SUBLANE == 0 and shape[0] // _SUBLANE >= 2 and shape[1] % _LANE == 0
+    return (
+        shape[0] % _SUBLANE == 0
+        and shape[0] // _SUBLANE >= 2
+        and shape[1] % _LANE == 0
+    )
 
 
 def _aligned_divisors(n: int, align: int):
     return [d for d in range(align, n + 1, align) if n % d == 0]
-
-
-def _pick_blocks(rows: int, width: int) -> tuple[int, int]:
-    """Choose (block_rows, block_cols) minimising halo read overhead
-    (8/pb + 128/wb) subject to the block byte budget.
-
-    An (8, 128) block always qualifies (4 KiB), so any `can_tile` shape
-    gets a valid choice — the round-2 scheme's failure mode (full-width
-    blocks exceeding VMEM on very wide boards) cannot occur."""
-    best = None
-    for pb in _aligned_divisors(rows, _SUBLANE):
-        for wb in _aligned_divisors(width, _LANE):
-            if pb * wb * 4 > _BLOCK_BYTES_TARGET:
-                break  # wb ascending: larger ones only get bigger
-            overhead = _SUBLANE / pb + _LANE / wb
-            key = (overhead, -pb * wb)
-            if best is None or key < best[0]:
-                best = (key, (pb, wb))
-    assert best is not None, (rows, width)
-    return best[1]
 
 
 def _validate_block(name: str, val: int, total: int, align: int) -> None:
@@ -105,7 +92,83 @@ def _validate_block(name: str, val: int, total: int, align: int) -> None:
         )
 
 
-def _tiled_kernel(
+def _ext_shape(pb: int, wb: int, width: int) -> tuple[int, int]:
+    """The extended-window shape a (pb, wb) block is computed over: +16
+    halo rows always; +256 halo cols only when the lane axis is split
+    (full-width blocks wrap columns with the cyclic lane rotate instead)."""
+    return pb + 2 * _SUBLANE, wb + (2 * _LANE if wb < width else 0)
+
+
+def _pick_blocks(rows: int, width: int) -> tuple[int, int]:
+    """The (block_rows, block_cols) ``_plan`` would run ``rows``/``grid2d``
+    with: minimise the ext/body compute ratio subject to the ext byte
+    budget, preferring full width. An (8, 128) block always qualifies
+    (ext 96 KiB), so any ``can_tile`` shape gets a valid choice."""
+    best = None
+    for pb in _aligned_divisors(rows, _SUBLANE):
+        for wb in _aligned_divisors(width, _LANE):
+            er, ec = _ext_shape(pb, wb, width)
+            if er * ec * 4 > _EXT_BYTES_TARGET:
+                continue
+            full_width = wb == width
+            ratio = (er * ec) / (pb * wb)
+            key = (not full_width, ratio, -pb * wb)
+            if best is None or key < best[0]:
+                best = (key, (pb, wb))
+    assert best is not None, (rows, width)
+    return best[1]
+
+
+def _plan(
+    rows: int,
+    width: int,
+    block_rows: int | None = None,
+    block_cols: int | None = None,
+) -> tuple[str, int, int]:
+    """-> (mode, block_rows, block_cols); see the module docstring's
+    regime table. Explicit block sizes are validated (a non-dividing size
+    would silently evolve a truncated board) and pin their axis."""
+    if block_rows is not None:
+        _validate_block("block_rows", block_rows, rows, _SUBLANE)
+    if block_cols is not None:
+        _validate_block("block_cols", block_cols, width, _LANE)
+    if block_cols is not None and block_cols < width:
+        pb = block_rows if block_rows is not None else _pick_blocks(rows, width)[0]
+        return "grid2d", pb, block_cols
+    if block_rows is not None:
+        # explicit rows, unpinned cols: full width if its ext fits the
+        # budget, otherwise fill the column split from the picker (a
+        # forced full-width ext on e.g. a 65536^2 board would be 6+ MiB —
+        # past the measured Mosaic allocation failure point)
+        er, ec = _ext_shape(block_rows, width, width)
+        if block_cols is not None or er * ec * 4 <= _EXT_BYTES_TARGET:
+            return "rows", block_rows, width
+        return "grid2d", block_rows, _pick_blocks(rows, width)[1]
+    if block_cols is not None:  # block_cols == width: pinned full width
+        return "rows", _pick_blocks(rows, width)[0], width
+    pb, wb = _pick_blocks(rows, width)
+    return ("rows" if wb == width else "grid2d"), pb, wb
+
+
+def _tiled_kernel_rows(
+    top_ref, body_ref, bot_ref, out_ref, *, birth_mask, survive_mask, interpret
+):
+    # 8-row edge strips only: (1 + 16/pb)x read instead of 3x, and the
+    # ext stays sublane-aligned
+    ext = jnp.concatenate([top_ref[:], body_ref[:], bot_ref[:]], axis=0)
+    from .pallas_stencil import pick_rot1
+
+    out = bit_step(
+        ext,
+        0,
+        pick_rot1(interpret),
+        birth_mask=birth_mask,
+        survive_mask=survive_mask,
+    )
+    out_ref[:] = out[_SUBLANE:-_SUBLANE, :]
+
+
+def _tiled_kernel_2d(
     tl_ref,
     top_ref,
     tr_ref,
@@ -121,23 +184,20 @@ def _tiled_kernel(
     survive_mask,
     interpret,
 ):
-    # The halo blocks are full (8, .) / (., 128) tiles — genuine board
-    # windows, not just the single adjacent word-row/-column — so the
-    # extended block stays sublane/lane ALIGNED: every rotate inside
-    # bit_step is a native tile-aligned op (a (pb+2, wb+2) ext measured
-    # ~2.5x slower from Mosaic's unaligned-lane handling). Temporaries
-    # scale with (pb+16)(wb+256), ~1.4x the body, not 3x.
+    # nine views of the same array: body + the eight neighbours' edge
+    # tiles, concatenated into a fully tile-aligned torus window
     top = jnp.concatenate([tl_ref[:], top_ref[:], tr_ref[:]], axis=1)
     mid = jnp.concatenate([left_ref[:], body_ref[:], right_ref[:]], axis=1)
     bot = jnp.concatenate([bl_ref[:], bot_ref[:], br_ref[:]], axis=1)
     ext = jnp.concatenate([top, mid, bot], axis=0)
     from .pallas_stencil import pick_rot1
 
-    rot1 = pick_rot1(interpret)
-    # cyclic rotates only contaminate ext's outer ring, well clear of the
-    # interior slice
     out = bit_step(
-        ext, 0, rot1, birth_mask=birth_mask, survive_mask=survive_mask
+        ext,
+        0,
+        pick_rot1(interpret),
+        birth_mask=birth_mask,
+        survive_mask=survive_mask,
     )
     out_ref[:] = out[_SUBLANE:-_SUBLANE, _LANE:-_LANE]
 
@@ -155,21 +215,15 @@ def _tiled_compiled(
     from jax.experimental import pallas as pl
 
     rows, width = shape
-    auto = (
-        _pick_blocks(rows, width) if not (block_rows and block_cols) else None
-    )
-    pb = block_rows or auto[0]
-    wb = block_cols or auto[1]
-    _validate_block("block_rows", pb, rows, _SUBLANE)
-    _validate_block("block_cols", wb, width, _LANE)
+    mode, pb, wb = _plan(rows, width, block_rows, block_cols)
     gr, gc = rows // pb, width // wb
     rsub, csub = pb // _SUBLANE, wb // _LANE  # sublane/lane tiles per block
 
-    # Index maps are in BLOCK units of each spec's own block shape. Edge
+    # Index maps are in BLOCK units of each spec's own block shape. Halo
     # blocks address the neighbour's boundary tile; modulo wraps the torus
     # (including the degenerate single-block-per-axis grids, where the
     # neighbour is the block itself).
-    def up(i):  # topmost 8-row tile of the row-block above
+    def up(i):  # bottommost 8-row tile of the row-block above
         return ((i - 1) % gr) * rsub + rsub - 1
 
     def down(i):
@@ -181,35 +235,48 @@ def _tiled_compiled(
     def rgt(j):
         return ((j + 1) % gc) * csub
 
-    kernel = functools.partial(
-        _tiled_kernel,
-        birth_mask=birth_mask,
-        survive_mask=survive_mask,
-        interpret=interpret,
+    masks = dict(
+        birth_mask=birth_mask, survive_mask=survive_mask, interpret=interpret
     )
-    one_turn = pl.pallas_call(
-        kernel,
-        grid=(gr, gc),
-        in_specs=[
-            pl.BlockSpec((_SUBLANE, _LANE), lambda i, j: (up(i), lft(j))),
-            pl.BlockSpec((_SUBLANE, wb), lambda i, j: (up(i), j)),
-            pl.BlockSpec((_SUBLANE, _LANE), lambda i, j: (up(i), rgt(j))),
-            pl.BlockSpec((pb, _LANE), lambda i, j: (i, lft(j))),
-            pl.BlockSpec((pb, wb), lambda i, j: (i, j)),
-            pl.BlockSpec((pb, _LANE), lambda i, j: (i, rgt(j))),
-            pl.BlockSpec((_SUBLANE, _LANE), lambda i, j: (down(i), lft(j))),
-            pl.BlockSpec((_SUBLANE, wb), lambda i, j: (down(i), j)),
-            pl.BlockSpec((_SUBLANE, _LANE), lambda i, j: (down(i), rgt(j))),
-        ],
-        out_specs=pl.BlockSpec((pb, wb), lambda i, j: (i, j)),
-        out_shape=jax.ShapeDtypeStruct(shape, jnp.int32),
-        interpret=interpret,
-    )
+    if mode == "rows":
+        one_turn = pl.pallas_call(
+            functools.partial(_tiled_kernel_rows, **masks),
+            grid=(gr,),
+            in_specs=[
+                pl.BlockSpec((_SUBLANE, wb), lambda i: (up(i), 0)),
+                pl.BlockSpec((pb, wb), lambda i: (i, 0)),
+                pl.BlockSpec((_SUBLANE, wb), lambda i: (down(i), 0)),
+            ],
+            out_specs=pl.BlockSpec((pb, wb), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct(shape, jnp.int32),
+            interpret=interpret,
+        )
+        n_in = 3
+    else:
+        one_turn = pl.pallas_call(
+            functools.partial(_tiled_kernel_2d, **masks),
+            grid=(gr, gc),
+            in_specs=[
+                pl.BlockSpec((_SUBLANE, _LANE), lambda i, j: (up(i), lft(j))),
+                pl.BlockSpec((_SUBLANE, wb), lambda i, j: (up(i), j)),
+                pl.BlockSpec((_SUBLANE, _LANE), lambda i, j: (up(i), rgt(j))),
+                pl.BlockSpec((pb, _LANE), lambda i, j: (i, lft(j))),
+                pl.BlockSpec((pb, wb), lambda i, j: (i, j)),
+                pl.BlockSpec((pb, _LANE), lambda i, j: (i, rgt(j))),
+                pl.BlockSpec((_SUBLANE, _LANE), lambda i, j: (down(i), lft(j))),
+                pl.BlockSpec((_SUBLANE, wb), lambda i, j: (down(i), j)),
+                pl.BlockSpec((_SUBLANE, _LANE), lambda i, j: (down(i), rgt(j))),
+            ],
+            out_specs=pl.BlockSpec((pb, wb), lambda i, j: (i, j)),
+            out_shape=jax.ShapeDtypeStruct(shape, jnp.int32),
+            interpret=interpret,
+        )
+        n_in = 9
 
     @jax.jit
     def run(packed):
         return lax.fori_loop(
-            0, n, lambda _, p: one_turn(p, p, p, p, p, p, p, p, p), packed
+            0, n, lambda _, p: one_turn(*([p] * n_in)), packed
         )
 
     return run
@@ -224,7 +291,7 @@ def tiled_bit_step_n_fn(
 ):
     """A ``(packed_int32 [P, W], n) -> packed`` for word_axis=0 bitboards of
     any size: n turns in one dispatch, one grid-tiled kernel launch per
-    turn, ~BW-floor HBM traffic (edge-only halo reads). Row-packed layout
+    turn, regime-picked blocks (see module docstring). Row-packed layout
     only (the layout every large-board path uses — lanes stay W wide)."""
     birth = rule.birth_mask if rule else CONWAY_BIRTH_MASK
     survive = rule.survive_mask if rule else CONWAY_SURVIVE_MASK
