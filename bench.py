@@ -7,27 +7,31 @@ single-chip BASELINE configs:
   config 2: 128x128  — pallas VMEM bitboard kernel
   config 3: 512x512  — pallas VMEM bitboard kernel (HEADLINE) + the
             engine-driven number (Engine.run with the packed BitPlane,
-            chunked dispatches — what a real session achieves)
+            pipelined chunk dispatches — what a real session achieves)
   config 4: 4096x4096 — grid-tiled pallas bitboard (the packed board
             exceeds the whole-board VMEM gate, ops/pallas_stencil.fits_vmem,
             so BitPlane routes to ops/pallas_tiled.py)
-  config 5 (single-chip shape): 16384^2 sparse R-pentomino via the
-            streamed big-board path (bigboard.py) — the board exists only
-            as a 32 MiB packed bitboard on device, evolved by the
-            grid-tiled pallas kernel (4.5x the XLA fallback)
+  config 5: BOTH the 16384^2 waypoint AND the true BASELINE scale,
+            65536^2 sparse R-pentomino — the board exists only as a
+            packed bitboard on device (512 MiB at 65536^2), evolved by
+            the grid-tiled pallas kernel; timed calls sync via a
+            device-side popcount, never a state transfer
 
 Parity gates: exact alive counts against check/alive/512x512.csv at turns
 1000 and 10000 plus the period-2 steady state; 128^2 against a numpy
 oracle at 1000 turns; 4096^2 bitboard against the independent roll-stencil
-implementation at 100 turns (on-device array equality); 16384^2
-R-pentomino against the oracle-validated 1000-turn population (156,
-verified on a 1536^2 window with envelope check).
+implementation at 100 turns (on-device array equality); 16384^2 and
+65536^2 R-pentomino against the oracle-validated 1000-turn population
+(156, verified on a 1536^2 window with envelope check —
+tests/test_bigboard.py).
 
-Methodology: the remote-TPU tunnel adds a fixed ~0.1 s dispatch+transfer
-overhead per call, so throughput is the MARGINAL cost between an n_lo- and
-an n_hi-turn run (overhead cancels). Each endpoint is min over REPS=5
-timed runs; the JSON reports median-based variance and the fixed-overhead
-residual so run-to-run spread is visible (VERDICT.md round-1 item 10).
+Methodology: the remote-TPU tunnel adds a fixed ~0.1 s dispatch overhead
+per call with occasional ~50 ms spikes, so throughput is the MARGINAL
+cost between an n_lo- and an n_hi-turn run (overhead cancels). Each
+endpoint is min over REPS=5 timed runs; a fit whose marginal work does
+not dominate the min-estimator's spread by NOISE_MARGIN, or is
+non-positive, raises instead of publishing (the round-2 c5 entry was a
+negative throughput born of exactly that).
 
 Prints exactly ONE JSON line:
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}
@@ -97,8 +101,11 @@ def marginal(time_fn, n_lo, n_hi, label="?"):
 
     lo, hi = sample(n_lo), sample(n_hi)
     per_turn = (min(hi) - min(lo)) / (n_hi - n_lo)
+    # stability of the min-estimator itself: the gap between the two best
+    # runs per endpoint (medians inflate under the tunnel's occasional
+    # one-sided latency spikes, which min() is already robust to)
     spread = max(
-        statistics.median(lo) - min(lo), statistics.median(hi) - min(hi)
+        sorted(lo)[1] - min(lo), sorted(hi)[1] - min(hi)
     )
     details = {
         "n_lo": n_lo,
@@ -114,6 +121,11 @@ def marginal(time_fn, n_lo, n_hi, label="?"):
             (statistics.median(hi) - statistics.median(lo)) / (n_hi - n_lo) * 1e6,
             5,
         ),
+        # the gate's inputs, so borderline fits are auditable after the fact
+        "spread_s": round(spread, 4),
+        "noise_margin": round((min(hi) - min(lo)) / spread, 1)
+        if spread > 0
+        else None,
     }
     marginal_work = min(hi) - min(lo)
     if per_turn <= 0:
@@ -163,7 +175,7 @@ def main() -> int:
             return 1
     print("parity 512^2 ok (turns 1000, 10000)", file=sys.stderr)
 
-    n_lo, n_hi = 100_000, 1_100_000
+    n_lo, n_hi = 100_000, 2_100_000
     for n in (n_lo, n_hi):  # warm/compile + steady-state gate
         alive = int(np.count_nonzero(bitpack.unpack(evolve(n), word_axis)))
         if alive != STEADY_512[n % 2]:
@@ -234,7 +246,9 @@ def main() -> int:
         # popcount sync: timed calls never transfer the packed state
         return bitpack.alive_count_packed(plane.step_n(state, n))
 
-    n4_lo, n4_hi = 2_000, 12_000  # config-4 scale: 10k turns
+    # 60k marginal turns (~0.4s of work at ~7us/turn): the tunnel's ~35ms
+    # round-trip noise spikes must be dominated 5x for the fit to publish
+    n4_lo, n4_hi = 2_000, 62_000
     evolve4k(n4_lo), evolve4k(n4_hi)
     pt4k, det4k = marginal(evolve4k, n4_lo, n4_hi, "c4_4096_tiled_bitboard")
     extra["c4_4096_tiled_bitboard"] = dict(
@@ -273,7 +287,7 @@ def main() -> int:
     print(
         json.dumps(
             {
-                "metric": "cell-updates/sec (512x512 Conway, marginal over 1M turns, single chip)",
+                "metric": "cell-updates/sec (512x512 Conway, marginal over 2M turns, single chip)",
                 "value": headline,
                 "unit": "cell-updates/s",
                 "vs_baseline": headline / BASELINE_CELL_UPDATES_PER_SEC,

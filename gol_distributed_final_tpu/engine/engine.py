@@ -245,13 +245,18 @@ class Engine:
                     if len(inflight) > _PIPELINE_DEPTH:
                         inflight.popleft().block_until_ready()
                 elapsed = time.monotonic() - t0
-                if growing and (
-                    chunk >= self.config.max_chunk
-                    or elapsed >= self.config.target_dispatch_seconds
-                ):
-                    # whichever way doubling ends — size cap or wall-clock
-                    # cap — later chunks go through the async pipeline
-                    growth_done = True
+                if growing:
+                    if (
+                        chunk >= self.config.max_chunk
+                        or elapsed >= self.config.target_dispatch_seconds
+                    ):
+                        # whichever way doubling ends — size cap or wall-
+                        # clock cap — later chunks go async; the pipelined
+                        # elapsed (~0, no sync) must never re-trigger
+                        # doubling past the wall-clock cap
+                        growth_done = True
+                    else:
+                        chunk = min(chunk * 2, self.config.max_chunk)
 
                 with self._lock:
                     prev_host = self._world_host if emit_flips else None
@@ -268,15 +273,6 @@ class Engine:
                     for y, x in zip(*changed):
                         emit(CellFlipped(turn_now, Cell(int(x), int(y))))
                     emit(TurnComplete(turn_now))
-
-                # grow the chunk while dispatches stay cheap (compile count
-                # is O(log max_chunk) thanks to doubling)
-                if (
-                    not emit_flips
-                    and chunk < self.config.max_chunk
-                    and elapsed < self.config.target_dispatch_seconds
-                ):
-                    chunk = min(chunk * 2, self.config.max_chunk)
 
             with self._lock:
                 self._sync_host()

@@ -126,8 +126,21 @@ def _row_popcounts(packed):
 
 def alive_count_packed(packed) -> int:
     """Alive cells of a bitboard: a device-side popcount reduction — no
-    unpack, ~4*H bytes cross the device boundary instead of H*W."""
-    return int(np.sum(np.asarray(_row_popcounts(packed)), dtype=np.int64))
+    unpack, ~4*H bytes cross the device boundary instead of H*W.
+
+    Multihost-safe: on a global array with non-addressable shards (a
+    ``jax.distributed`` job where each process owns a row range) the row
+    popcounts are all-gathered across processes, so every rank returns the
+    GLOBAL count — ``np.asarray`` on such an array would raise."""
+    pc = _row_popcounts(packed)
+    if getattr(pc, "is_fully_addressable", True):
+        return int(np.sum(np.asarray(pc), dtype=np.int64))
+    from jax.experimental import multihost_utils
+
+    # tiled=True: assemble the GLOBAL row vector (required for global
+    # non-fully-addressable inputs) rather than stacking per-process copies
+    gathered = multihost_utils.process_allgather(pc, tiled=True)
+    return int(np.sum(gathered, dtype=np.int64))
 
 
 def _default_rot1(a, shift: int, axis: int):

@@ -148,14 +148,19 @@ def _bit_kernel(
 
     rot1 = pick_rot1(interpret)
 
-    out_ref[:] = lax.fori_loop(
-        0,
-        n,
-        lambda _, b: bit_step(
+    def step(b):
+        return bit_step(
             b, word_axis, rot1, birth_mask=birth_mask, survive_mask=survive_mask
-        ),
-        packed_ref[:],
-    )
+        )
+
+    # two turns per loop iteration: at VMEM-resident sizes the fori_loop's
+    # per-iteration overhead is ~17% of a turn (measured 154 -> 129 ns/turn
+    # at 512^2 on v5e; deeper unrolls regressed — register pressure), and
+    # Mosaic's fori_loop rejects partial `unroll`, so unroll by hand
+    out = lax.fori_loop(0, n // 2, lambda _, b: step(step(b)), packed_ref[:])
+    if n % 2:
+        out = step(out)
+    out_ref[:] = out
 
 
 @functools.lru_cache(maxsize=None)

@@ -143,7 +143,21 @@ def _plan(
         er, ec = _ext_shape(block_rows, width, width)
         if block_cols is not None or er * ec * 4 <= _EXT_BYTES_TARGET:
             return "rows", block_rows, width
-        return "grid2d", block_rows, _pick_blocks(rows, width)[1]
+        # size the column split FOR the pinned rows (reusing the picker's
+        # wb — chosen for a different pb — can exceed the ext budget)
+        fitting = [
+            wb
+            for wb in _aligned_divisors(width, _LANE)
+            if wb < width
+            and (block_rows + 2 * _SUBLANE) * (wb + 2 * _LANE) * 4
+            <= _EXT_BYTES_TARGET
+        ]
+        if not fitting:
+            raise ValueError(
+                f"block_rows={block_rows} leaves no block_cols fitting the "
+                f"VMEM ext budget for packed shape {(rows, width)}"
+            )
+        return "grid2d", block_rows, max(fitting)
     if block_cols is not None:  # block_cols == width: pinned full width
         return "rows", _pick_blocks(rows, width)[0], width
     pb, wb = _pick_blocks(rows, width)

@@ -140,9 +140,21 @@ class ShardedBitPlane:
         return self._step_n(state, n)
 
     def decode(self, state) -> np.ndarray:
-        return np.asarray(self._decode(state))
+        """Full host board — single-host (fully addressable) states only;
+        a multihost rank cannot materialise rows it does not own. Use
+        ``decode_global`` + per-shard reads in ``jax.distributed`` jobs."""
+        return np.asarray(self.decode_global(state))
+
+    def decode_global(self, state):
+        """The unpacked uint8 board as a GLOBAL mesh-sharded device array.
+        Multihost-safe: each rank reads its own rows via
+        ``.addressable_shards`` (tests/multihost_child.py) instead of
+        pulling the whole board to one host."""
+        return self._decode(state)
 
     def alive_count(self, state) -> int:
+        # multihost-safe: all-gathers row popcounts when shards are not
+        # fully addressable (ops/bitpack.alive_count_packed)
         from ..ops.bitpack import alive_count_packed
 
         return alive_count_packed(state)

@@ -66,7 +66,12 @@ def main() -> int:
     plane = make_bit_plane(mesh, (size, size))
     assert plane is not None
     state = plane.step_n(plane.encode(board), turns)
-    bit_out = plane._decode(state)  # stays a global sharded device array
+    bit_out = plane.decode_global(state)  # a global sharded device array
+    # the public count path: every rank must report the GLOBAL count even
+    # though it only holds its own shards (allgathered row popcounts)
+    global_count = plane.alive_count(state)
+    want_count = int(jax.jit(lambda b: (b != 0).sum())(out))  # replicated
+    assert global_count == want_count, (global_count, want_count)
 
     # gather each array's LOCAL rows and compare shard-wise
     def local_rows(arr):

@@ -186,3 +186,112 @@ def test_super_quit_sets_flag():
     engine.super_quit()
     t.join(timeout=10)
     assert engine.super_quit_requested
+
+
+def test_pipeline_engages_when_growth_stops_at_max_chunk():
+    """Once chunk doubling hits max_chunk the loop must dispatch
+    asynchronously (no per-chunk block_until_ready): a step result whose
+    block_until_ready is counted should be awaited far fewer times than
+    there are chunks."""
+    board = small_board(3, 64)
+    syncs = {"n": 0}
+
+    class Counting:
+        def __init__(self, arr):
+            self.arr = arr
+
+        def block_until_ready(self):
+            syncs["n"] += 1
+            return self
+
+    import jax.numpy as jnp
+    from gol_distributed_final_tpu.models import CONWAY
+
+    def step_n(b, n):
+        out = CONWAY.step_n(jnp.asarray(getattr(b, "arr", b)), int(n))
+        return Counting(out)
+
+    class WrapPlane:
+        rule = CONWAY
+
+        def encode(self, b):
+            return jnp.asarray(b)
+
+        def step_n(self, state, n):
+            return step_n(state, n)
+
+        def decode(self, state):
+            return np.asarray(getattr(state, "arr", state))
+
+        def alive_count(self, state):
+            return int(np.count_nonzero(self.decode(state)))
+
+    # 64 chunks of 4 turns after instant growth: with the depth-3 window,
+    # syncs ~= chunks - depth; the old synchronous loop did one per chunk
+    eng = Engine(EngineConfig(min_chunk=4, max_chunk=4))
+    res = eng.run(
+        Params(turns=256, image_width=64, image_height=64),
+        board,
+        plane=WrapPlane(),
+    )
+    assert res.turns_completed == 256
+    n_chunks = 256 // 4
+    assert syncs["n"] <= n_chunks - 2, syncs["n"]
+    # parity: pipelining must not change the result
+    want = board
+    for _ in range(256):
+        want = vector_step(want)
+    np.testing.assert_array_equal(res.world, want)
+
+
+def test_pipeline_engages_when_growth_stops_on_slow_dispatch():
+    """Growth can also end via target_dispatch_seconds (large boards never
+    reach max_chunk). Later chunks must then go through the async window
+    rather than paying a synchronous wait per chunk — the round-3 review
+    caught exactly this path staying synchronous forever."""
+    board = small_board(4, 64)
+    calls = {"sync": 0, "chunks": []}
+
+    import jax.numpy as jnp
+    from gol_distributed_final_tpu.models import CONWAY
+
+    class SlowPlane:
+        rule = CONWAY
+
+        def encode(self, b):
+            return jnp.asarray(b)
+
+        def step_n(self, state, n):
+            calls["chunks"].append(int(n))
+            out = CONWAY.step_n(getattr(state, "arr", state), int(n))
+
+            class R:
+                def __init__(self, arr):
+                    self.arr = arr
+
+                def block_until_ready(self):
+                    calls["sync"] += 1
+                    time.sleep(0.05)  # every dispatch exceeds the target
+                    return self
+
+            return R(out)
+
+        def decode(self, state):
+            return np.asarray(getattr(state, "arr", state))
+
+        def alive_count(self, state):
+            return int(np.count_nonzero(self.decode(state)))
+
+    eng = Engine(
+        EngineConfig(min_chunk=1, max_chunk=1 << 20, target_dispatch_seconds=0.01)
+    )
+    res = eng.run(
+        Params(turns=40, image_width=64, image_height=64),
+        board,
+        plane=SlowPlane(),
+    )
+    assert res.turns_completed == 40
+    # first chunk (size 1) is timed synchronously and ends growth; the
+    # remaining 39 single-turn chunks flow through the depth-3 window
+    assert calls["chunks"][0] == 1 and len(calls["chunks"]) == 40
+    assert calls["sync"] <= len(calls["chunks"]) - 2, calls["sync"]
