@@ -34,6 +34,7 @@ from typing import Callable, Optional
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -73,29 +74,131 @@ def _local_bit_step(block, *, rule: LifeRule, mesh_shape, word_axis: int):
     return out[1:-1, 1:-1]
 
 
+def _local_bit_step_pallas(block, *, rule: LifeRule, mesh_shape, interpret):
+    """One turn on a local block through the grid-tiled pallas kernel
+    (word_axis=0 only).
+
+    Beyond the whole-board VMEM gate, the XLA ``bit_step`` spills its
+    ~10 bit-plane temporaries to HBM — ~5x slower per device at 16384^2
+    (the single-chip finding, ops/pallas_tiled.py). On a multi-chip mesh
+    each device's LOCAL block crosses that same gate long before the
+    global board is large, so the local compute routes to the pallas
+    kernel.
+
+    The kernel needs a sublane/lane-ALIGNED extended block, but only the
+    innermost halo word ever feeds the kept interior (a single turn reads
+    words +-1), so the exchange ships the same thickness-1 halos as the
+    XLA path and zero-pads locally — fused into the halo concats — out to
+    the (h+16, w+256) tile-aligned shape: alignment costs no extra ICI
+    traffic and no extra materialisation. The padded ring and the torus
+    wrap of the kernel only contaminate outputs that are sliced away."""
+    from ..ops.pallas_tiled import _LANE, _SUBLANE, _tiled_compiled
+
+    nrows, ncols = mesh_shape
+    # pad = tile - (1 halo word): body lands at offset (_SUBLANE, _LANE)
+    ext = _exchange(block, ROWS, nrows, dim=0, pad=_SUBLANE - 1)
+    ext = _exchange(ext, COLS, ncols, dim=1, pad=_LANE - 1)
+    out = _tiled_compiled(
+        1, tuple(ext.shape), interpret, rule.birth_mask, rule.survive_mask
+    )(ext)
+    return out[_SUBLANE:-_SUBLANE, _LANE:-_LANE]
+
+
+def _pallas_local_ok(block_shape, word_axis: int) -> bool:
+    """Route the local step to pallas when the LOCAL block is past the
+    VMEM working-set gate (where XLA starts spilling) and the tile-aligned
+    halo scheme applies."""
+    from ..ops.pallas_stencil import fits_vmem
+
+    if word_axis != 0:
+        return False
+    if not _pallas_local_aligned(block_shape):
+        return False
+    return not fits_vmem(block_shape, itemsize=4)
+
+
+def _pallas_local_aligned(block_shape) -> bool:
+    """The tile-alignment half of the gate: the local block and its
+    (h + 2*_SUBLANE, w + 2*_LANE) ext must satisfy the kernel's
+    sublane/lane contract (constants shared with ops/pallas_tiled)."""
+    from ..ops.pallas_tiled import _LANE, _SUBLANE, can_tile
+
+    h, w = block_shape
+    return (
+        h % _SUBLANE == 0
+        and w % _LANE == 0
+        and can_tile((h + 2 * _SUBLANE, w + 2 * _LANE))
+    )
+
+
 def packed_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(ROWS, COLS))
 
 
 def sharded_bit_step_n_fn(
-    mesh: Mesh, rule: LifeRule = CONWAY, word_axis: int = 0
+    mesh: Mesh,
+    rule: LifeRule = CONWAY,
+    word_axis: int = 0,
+    *,
+    pallas_local: bool | None = None,
+    interpret: bool | None = None,
 ) -> Callable:
     """A jitted ``(packed, n) -> packed`` over a P('rows','cols')-sharded
     int32 bitboard: n turns in ONE dispatch, the fori_loop (halo ppermutes
-    included) inside shard_map."""
+    included) inside shard_map.
+
+    ``pallas_local`` routes each device's local compute through the
+    grid-tiled pallas kernel (None = auto: on real TPU when the local
+    block is past the VMEM gate where XLA spills; see
+    ``_pallas_local_ok``). ``interpret`` forces pallas interpret mode —
+    the CPU-mesh test hook."""
     mesh_shape = (mesh.shape[ROWS], mesh.shape[COLS])
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
     local = functools.partial(
         _local_bit_step, rule=rule, mesh_shape=mesh_shape, word_axis=word_axis
+    )
+    local_pallas = functools.partial(
+        _local_bit_step_pallas,
+        rule=rule,
+        mesh_shape=mesh_shape,
+        interpret=interpret,
     )
     sharding = packed_sharding(mesh)
 
     @functools.lru_cache(maxsize=None)
     def _compiled(n: int):
         def local_n(block):
-            return lax.fori_loop(0, n, lambda _, b: local(b), block)
+            # trace-time routing on the static LOCAL block shape
+            if pallas_local is None:
+                use_pallas = (
+                    _pallas_local_ok(block.shape, word_axis) and not interpret
+                )
+            else:
+                use_pallas = pallas_local
+                if use_pallas and word_axis != 0:
+                    # the pallas kernels hardcode row packing; silently
+                    # running them on a column-packed board would return a
+                    # wrong evolution
+                    raise ValueError(
+                        "pallas_local=True requires word_axis=0"
+                    )
+                if use_pallas and not _pallas_local_aligned(block.shape):
+                    raise ValueError(
+                        f"pallas_local=True requires a sublane/lane-aligned "
+                        f"local block; got {tuple(block.shape)}"
+                    )
+            step = local_pallas if use_pallas else local
+            return lax.fori_loop(0, n, lambda _, b: step(b), block)
 
         sharded = jax.shard_map(
-            local_n, mesh=mesh, in_specs=P(ROWS, COLS), out_specs=P(ROWS, COLS)
+            local_n,
+            mesh=mesh,
+            in_specs=P(ROWS, COLS),
+            out_specs=P(ROWS, COLS),
+            # pallas_call emits vma-less ShapeDtypeStructs, which the
+            # varying-mesh-axes checker rejects inside shard_map
+            check_vma=False,
         )
         return jax.jit(sharded, in_shardings=sharding, out_shardings=sharding)
 
@@ -132,8 +235,6 @@ class ShardedBitPlane:
         )
 
     def encode(self, board):
-        import jax.numpy as jnp
-
         return self._encode(jnp.asarray(board))
 
     def step_n(self, state, n: int):

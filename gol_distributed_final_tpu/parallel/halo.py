@@ -41,12 +41,18 @@ def _ring_perm(n: int, direction: int) -> list[tuple[int, int]]:
     return [(i, (i + direction) % n) for i in range(n)]
 
 
-def _exchange(block, axis_name: str, n: int, dim: int):
+def _exchange(block, axis_name: str, n: int, dim: int, pad: int = 0):
     """Prepend/append wrap-around halo slices of thickness 1 along ``dim``,
     exchanged with ring neighbours on ``axis_name``.
 
     With a single device on the axis the halo is local wrap — the same
     concat, no communication.
+
+    ``pad`` adds that many ZERO slices outside each halo, fused into the
+    same concatenate: the pallas local step (parallel/bit_halo.py) needs a
+    tile-aligned extended block whose outer ring is never read, and a
+    separate jnp.pad would cost a full extra array materialisation
+    (~50 us/turn measured at 16384^2).
     """
     if dim == 0:
         first, last = block[:1], block[-1:]
@@ -59,7 +65,13 @@ def _exchange(block, axis_name: str, n: int, dim: int):
         # sends their last slice one step forward along the ring
         before = lax.ppermute(last, axis_name, _ring_perm(n, 1))
         after = lax.ppermute(first, axis_name, _ring_perm(n, -1))
-    return jnp.concatenate([before, block, after], axis=dim)
+    parts = [before, block, after]
+    if pad:
+        zshape = list(block.shape)
+        zshape[dim] = pad
+        zeros = jnp.zeros(zshape, block.dtype)
+        parts = [zeros, *parts, zeros]
+    return jnp.concatenate(parts, axis=dim)
 
 
 def _local_step(block, *, rule: LifeRule, mesh_shape: tuple[int, int]):

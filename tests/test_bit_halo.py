@@ -220,3 +220,41 @@ def test_engine_auto_uses_bit_plane():
     np.testing.assert_array_equal(result.world, want)
     snap = engine.retrieve(include_world=False)
     assert snap.alive_count == int(np.count_nonzero(want))
+
+
+@requires_8
+def test_pallas_local_step_parity_on_mesh():
+    """The pallas-routed local step (tile-thick halos + grid-tiled kernel
+    per device) must agree with the XLA local step across block and torus
+    boundaries — the multi-chip large-board path, exercised in interpret
+    mode on the CPU mesh."""
+    from gol_distributed_final_tpu.parallel.bit_halo import (
+        _pallas_local_ok,
+        packed_sharding,
+        sharded_bit_step_n_fn,
+    )
+    from gol_distributed_final_tpu.parallel.mesh import make_mesh
+    from gol_distributed_final_tpu.ops import bitpack
+
+    mesh = make_mesh((2, 4))
+    rng = np.random.default_rng(21)
+    board = np.where(rng.random((1024, 1024)) < 0.3, 255, 0).astype(np.uint8)
+    packed = jax.device_put(
+        bitpack.pack(board, 0), packed_sharding(mesh)
+    )  # [32, 1024] -> local blocks (16, 256): ext (32, 512) tiles cleanly
+    fast = sharded_bit_step_n_fn(mesh, pallas_local=True, interpret=True)
+    ref = sharded_bit_step_n_fn(mesh, pallas_local=False)
+    got, want = fast(packed, 6), ref(packed, 6)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_pallas_local_routing_gate():
+    """Auto-routing: local blocks past the VMEM working-set gate route to
+    pallas; small blocks and misaligned shapes stay on the XLA step."""
+    from gol_distributed_final_tpu.parallel.bit_halo import _pallas_local_ok
+
+    assert _pallas_local_ok((128, 8192), 0)  # 16384^2 over 4 chips: spills
+    assert not _pallas_local_ok((16, 256), 0)  # small: XLA/VMEM kernel fine
+    assert not _pallas_local_ok((12, 8192), 0)  # sublane-misaligned
+    assert not _pallas_local_ok((128, 8200), 0)  # lane-misaligned
+    assert not _pallas_local_ok((8192, 128), 1)  # column packing unsupported
