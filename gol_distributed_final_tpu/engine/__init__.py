@@ -1,3 +1,17 @@
+from .checkpoint import (
+    load_checkpoint,
+    load_packed_checkpoint,
+    save_checkpoint,
+    save_packed_checkpoint,
+)
 from .engine import Engine, RunResult, Snapshot
 
-__all__ = ["Engine", "RunResult", "Snapshot"]
+__all__ = [
+    "Engine",
+    "RunResult",
+    "Snapshot",
+    "load_checkpoint",
+    "load_packed_checkpoint",
+    "save_checkpoint",
+    "save_packed_checkpoint",
+]

@@ -22,24 +22,68 @@ import numpy as np
 from ..models import CONWAY, LifeRule
 
 
-def save_checkpoint(path, world, turn: int, rule: LifeRule = CONWAY) -> pathlib.Path:
-    """Returns the path actually written: ``np.savez_compressed`` appends
-    ``.npz`` whenever the name doesn't already end with it (so e.g.
-    ``ck.backup`` lands at ``ck.backup.npz``)."""
+def _save_npz(path, **arrays) -> pathlib.Path:
+    """Write a compressed npz, returning the path actually written:
+    ``np.savez_compressed`` appends ``.npz`` whenever the name doesn't
+    already end with it (so e.g. ``ck.backup`` lands at
+    ``ck.backup.npz``)."""
     path = pathlib.Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
-    np.savez_compressed(
+    np.savez_compressed(path, **arrays)
+    return path if path.suffix == ".npz" else path.with_name(path.name + ".npz")
+
+
+def save_checkpoint(path, world, turn: int, rule: LifeRule = CONWAY) -> pathlib.Path:
+    return _save_npz(
         path,
         board=np.asarray(world, np.uint8),
         turn=np.int64(turn),
         rulestring=np.str_(rule.rulestring),
     )
-    return path if path.suffix == ".npz" else path.with_name(path.name + ".npz")
 
 
 def load_checkpoint(path) -> tuple[np.ndarray, int, LifeRule]:
     with np.load(path, allow_pickle=False) as data:
+        if "packed" in data:
+            raise ValueError(
+                f"{path} is a packed-bitboard checkpoint; use "
+                "load_packed_checkpoint (unpacking a config-5-scale board "
+                "to bytes would materialise 32x the state on host)"
+            )
         board = data["board"].astype(np.uint8)
         turn = int(data["turn"])
         rule = LifeRule.from_rulestring(str(data["rulestring"]))
     return board, turn, rule
+
+
+def save_packed_checkpoint(
+    path, packed, turn: int, rule: LifeRule = CONWAY, word_axis: int = 0
+) -> pathlib.Path:
+    """Checkpoint a bit-packed board WITHOUT decoding it: the int32 words
+    cross the device boundary once and land compressed on disk (a 65536^2
+    board is 512 MiB packed vs 4 GiB as bytes — and a sparse one
+    compresses to almost nothing). The reference has no analogue; this is
+    the big-board (bigboard.py / BASELINE config 5) snapshot path."""
+    return _save_npz(
+        path,
+        packed=np.asarray(packed, np.int32),
+        word_axis=np.int64(word_axis),
+        turn=np.int64(turn),
+        rulestring=np.str_(rule.rulestring),
+    )
+
+
+def load_packed_checkpoint(path) -> tuple[np.ndarray, int, LifeRule, int]:
+    """-> (packed int32 array, turn, rule, word_axis) — the byte loader's
+    (board, turn, rule) shape with word_axis appended, so the two loaders
+    never swap the bare-int positions of turn and word_axis."""
+    with np.load(path, allow_pickle=False) as data:
+        if "packed" not in data:
+            raise ValueError(
+                f"{path} is a byte-board checkpoint; use load_checkpoint"
+            )
+        packed = data["packed"].astype(np.int32)
+        word_axis = int(data["word_axis"])
+        turn = int(data["turn"])
+        rule = LifeRule.from_rulestring(str(data["rulestring"]))
+    return packed, turn, rule, word_axis

@@ -51,6 +51,16 @@ def fits_vmem(shape: tuple[int, int], itemsize: int) -> bool:
     return shape[0] * shape[1] * itemsize * _WORKING_SET_FACTOR <= VMEM_BYTES
 
 
+def default_interpret() -> bool:
+    """Interpret-mode default for every pallas path: real Mosaic kernels
+    on TPU, the pallas interpreter elsewhere (the CPU test mesh). ONE
+    probe shared by all call sites so a future change (per-device
+    platforms, env overrides) lands everywhere at once."""
+    import jax
+
+    return jax.devices()[0].platform != "tpu"
+
+
 def _rot1(a, shift: int, axis: int, *, interpret: bool = False):
     """Toroidal rotate by +/-1 along an axis, Mosaic-safe.
 
@@ -223,7 +233,7 @@ def pallas_bit_step_n_fn(
     birth = rule.birth_mask if rule else CONWAY_BIRTH_MASK
     survive = rule.survive_mask if rule else CONWAY_SURVIVE_MASK
     if interpret is None:
-        interpret = jax.devices()[0].platform != "tpu"
+        interpret = default_interpret()
 
     def step_n(board, n):
         n = int(n)
@@ -254,7 +264,7 @@ def pallas_step_n_fn(
 
     rule = rule or CONWAY
     if interpret is None:
-        interpret = jax.devices()[0].platform != "tpu"
+        interpret = default_interpret()
     if fallback is None:
         fallback = rule.step_n
 

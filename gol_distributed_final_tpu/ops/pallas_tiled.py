@@ -310,7 +310,9 @@ def tiled_bit_step_n_fn(
     birth = rule.birth_mask if rule else CONWAY_BIRTH_MASK
     survive = rule.survive_mask if rule else CONWAY_SURVIVE_MASK
     if interpret is None:
-        interpret = jax.devices()[0].platform != "tpu"
+        from .pallas_stencil import default_interpret
+
+        interpret = default_interpret()
 
     def step_n(packed, n):
         return _tiled_compiled(

@@ -79,13 +79,11 @@ class BitPlane:
         word_axis: int = 0,
         interpret: Optional[bool] = None,
     ):
-        import jax
+        from .pallas_stencil import default_interpret
 
         self.rule = rule
         self.word_axis = word_axis
-        self.interpret = (
-            jax.devices()[0].platform != "tpu" if interpret is None else interpret
-        )
+        self.interpret = default_interpret() if interpret is None else interpret
 
     def encode(self, board):
         import jax.numpy as jnp

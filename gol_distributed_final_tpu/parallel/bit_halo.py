@@ -154,7 +154,9 @@ def sharded_bit_step_n_fn(
     the CPU-mesh test hook."""
     mesh_shape = (mesh.shape[ROWS], mesh.shape[COLS])
     if interpret is None:
-        interpret = jax.devices()[0].platform != "tpu"
+        from ..ops.pallas_stencil import default_interpret
+
+        interpret = default_interpret()
     local = functools.partial(
         _local_bit_step, rule=rule, mesh_shape=mesh_shape, word_axis=word_axis
     )

@@ -109,3 +109,52 @@ def test_host_row_range_single_process():
     assert host_row_range(mesh1d, 64) == (0, 64)
     with pytest.raises(ValueError, match="does not divide"):
         host_row_range(mesh, 30)
+
+
+def test_packed_checkpoint_roundtrip_and_resume(tmp_path):
+    """The big-board snapshot path: checkpoint the PACKED bitboard (no
+    decode — a config-5 board would be 4 GiB as bytes), resume, and the
+    continuation is bit-identical to an uninterrupted evolution."""
+    from gol_distributed_final_tpu.engine.checkpoint import (
+        load_packed_checkpoint,
+        save_packed_checkpoint,
+    )
+    from gol_distributed_final_tpu.ops import bitpack
+
+    import numpy as np
+
+    rng = np.random.default_rng(17)
+    board = np.where(rng.random((128, 128)) < 0.3, 255, 0).astype(np.uint8)
+    packed = bitpack.pack(board, 0)
+
+    mid = bitpack.bit_step_n(packed, 40, 0)
+    p = save_packed_checkpoint(tmp_path / "big.npz", mid, 40)
+    loaded, turn, rule, word_axis = load_packed_checkpoint(p)
+    assert (turn, rule.rulestring, word_axis) == (40, "B3/S23", 0)
+    np.testing.assert_array_equal(loaded, np.asarray(mid))
+
+    resumed = bitpack.bit_step_n(loaded, 60, word_axis)
+    straight = bitpack.bit_step_n(packed, 100, 0)
+    np.testing.assert_array_equal(np.asarray(resumed), np.asarray(straight))
+
+
+def test_checkpoint_format_cross_loading_raises(tmp_path):
+    """Each loader rejects the other format with an actionable error
+    instead of a KeyError (mixing them up at 65536^2 would try to build a
+    4 GiB host array)."""
+    from gol_distributed_final_tpu.engine.checkpoint import (
+        load_packed_checkpoint,
+        save_packed_checkpoint,
+    )
+    from gol_distributed_final_tpu.ops import bitpack
+
+    import numpy as np
+    import pytest
+
+    board = np.zeros((32, 32), np.uint8)
+    bytep = save_checkpoint(tmp_path / "b.npz", board, 1)
+    packp = save_packed_checkpoint(tmp_path / "p.npz", bitpack.pack(board, 0), 1)
+    with pytest.raises(ValueError, match="packed-bitboard checkpoint"):
+        load_checkpoint(packp)
+    with pytest.raises(ValueError, match="byte-board checkpoint"):
+        load_packed_checkpoint(bytep)
