@@ -5,10 +5,12 @@ byte raster that is 4 GiB; the reference materialises the full board in
 the controller, the broker AND every worker (SURVEY.md §5), capping board
 size at one machine's RAM. Here the board only ever exists as the int32
 bitboard on device (32x smaller), is seeded directly from sparse cell
-coordinates, evolves through the XLA bitboard plane (ops/plane.BitPlane —
-boards this size are far past the VMEM-kernel gate), and reaches disk as
-a stream of unpacked ROW BLOCKS through io/sharded.py pwrites. The full
-byte board never exists on host or device.
+coordinates, evolves through ops/plane.BitPlane (boards this size are far
+past the VMEM-kernel gate, so on TPU the plane routes to the grid-tiled
+pallas kernel — 65536^2 runs at ~3.6 ms/turn; the XLA bitboard step is
+the interpret/CPU fallback), and reaches disk as a stream of unpacked
+ROW BLOCKS through io/sharded.py pwrites. The full byte board never
+exists on host or device.
 
     state  = seed_packed(16384, r_pentomino(16384))   # 32 MiB, device
     state  = plane.step_n(state, turns)               # XLA bitboard
@@ -69,6 +71,35 @@ def seed_packed(size: int, cells: Cells, word_axis: int = 0):
         np.uint32(1) << np.asarray(bits, np.uint32),
     )
     return jnp.asarray(packed.view(np.int32))
+
+
+def decode_window(
+    state, y0: int, x0: int, h: int, w: int, word_axis: int = 0
+) -> np.ndarray:
+    """The uint8 window ``[y0:y0+h, x0:x0+w]`` of a packed board, decoded
+    without unpacking anything else — the inspection/visualisation surface
+    for boards whose full byte raster would be GiB-scale (the reference's
+    SDL window shows the whole board, sdl/window.go:22-104; at config-5
+    sizes only a window can ever be shown). Only the word rows covering
+    the window cross the packed->byte boundary."""
+    rows, cols = state.shape
+    height = rows * WORD if word_axis == 0 else rows
+    width = cols if word_axis == 0 else cols * WORD
+    if h <= 0 or w <= 0:
+        raise ValueError(f"window extent {h}x{w} must be positive")
+    if not (0 <= y0 and y0 + h <= height and 0 <= x0 and x0 + w <= width):
+        raise ValueError(
+            f"window [{y0}:{y0 + h}, {x0}:{x0 + w}] outside {height}x{width}"
+        )
+    if word_axis == 0:
+        r0, r1 = y0 // WORD, -(-(y0 + h) // WORD)
+        block = state[r0:r1, x0 : x0 + w]
+        rows_out = np.asarray(unpack_device(block, 0))
+        return rows_out[y0 - r0 * WORD : y0 - r0 * WORD + h]
+    c0, c1 = x0 // WORD, -(-(x0 + w) // WORD)
+    block = state[y0 : y0 + h, c0:c1]
+    cols_out = np.asarray(unpack_device(block, 1))
+    return cols_out[:, x0 - c0 * WORD : x0 - c0 * WORD + w]
 
 
 def stream_packed_to_pgm(path, state, word_axis: int = 0, row_block: int = 1024):

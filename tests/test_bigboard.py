@@ -108,3 +108,35 @@ def test_cli_smoke(tmp_path):
         window = vector_step(window)
     got = read_shard(out, 896, 896 + 256)[:, 896 : 896 + 256]
     np.testing.assert_array_equal(got, window)
+
+
+def test_decode_window_matches_oracle():
+    """A window decoded straight from the packed board — no full unpack —
+    equals the oracle evolution, including unaligned window origins."""
+    from gol_distributed_final_tpu.bigboard import decode_window
+    from gol_distributed_final_tpu.ops.plane import BitPlane
+
+    state = seed_packed(SIZE, r_pentomino(SIZE))
+    state = BitPlane().step_n(state, TURNS)
+    window = oracle_window()
+    got = decode_window(state, W0, W0, WIN, WIN)
+    np.testing.assert_array_equal(got, window)
+    # word-unaligned origin: offset by 5 rows, 3 cols into the window
+    got_off = decode_window(state, W0 + 5, W0 + 3, WIN - 5, WIN - 3)
+    np.testing.assert_array_equal(got_off, window[5:, 3:])
+
+
+def test_decode_window_bounds_and_axis1():
+    from gol_distributed_final_tpu.bigboard import decode_window
+    from gol_distributed_final_tpu.ops import bitpack
+
+    rng = np.random.default_rng(3)
+    board = np.where(rng.random((128, 128)) < 0.3, 255, 0).astype(np.uint8)
+    for axis in (0, 1):
+        packed = bitpack.pack(board, axis)
+        got = decode_window(packed, 17, 33, 50, 60, word_axis=axis)
+        np.testing.assert_array_equal(got, board[17:67, 33:93])
+    with pytest.raises(ValueError, match="outside"):
+        decode_window(bitpack.pack(board, 0), 100, 0, 50, 10)
+    with pytest.raises(ValueError, match="positive"):
+        decode_window(bitpack.pack(board, 0), 100, 0, -50, 10)
