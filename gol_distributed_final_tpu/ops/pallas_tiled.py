@@ -46,6 +46,11 @@ halo DMA over up to 127 turns of in-VMEM evolution — the halo tiles are
 256 cell-rows / 128 cell-columns deep) measured SLOWER (~165 us/turn):
 the in-kernel fori_loop defeats Mosaic's pipelining, so the single-turn
 form stands.
+
+Reference equivalence: each turn computes exactly worker/worker.go:15-70's
+``calculateNextState`` over the full board (via ops/bitpack.bit_step —
+bit-exact against the numpy oracle and the ``check/`` goldens at every
+size the suite and bench cover, up to 65536^2).
 """
 
 from __future__ import annotations
