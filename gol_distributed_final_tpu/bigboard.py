@@ -153,11 +153,20 @@ def run_big_board(
     rule: LifeRule = CONWAY,
     word_axis: int = 0,
     row_block: int = 1024,
+    engine=None,
 ) -> int:
     """Seed (sparse cells or a streamed PGM), evolve, stream out.
 
     Returns the final alive count (device-side popcount). The full byte
-    board never exists anywhere; peak host memory is one row block."""
+    board never exists anywhere; peak host memory is one row block.
+
+    With ``engine`` (an ``engine.Engine`` configured with
+    ``final_world=False`` — enforced), the evolution runs through the engine's
+    chunked control loop instead of one bare dispatch — pause / quit /
+    RetrieveCurrentData(count-only) / the 2-second ticker all work
+    mid-run on a board whose byte raster will never exist, closing the
+    gap between the reference's control surface (broker/broker.go:236-277)
+    and config-5 scale."""
     if (cells is None) == (in_path is None):
         raise ValueError("exactly one of cells / in_path must be given")
     if cells is not None:
@@ -165,7 +174,23 @@ def run_big_board(
     else:
         state = load_packed_from_pgm(in_path, word_axis, row_block)
     plane = BitPlane(rule, word_axis)
-    if turns:
+    if engine is not None:
+        if engine.config.final_world:
+            raise ValueError(
+                "run_big_board needs an Engine(EngineConfig("
+                "final_world=False)): the default run exit decodes the "
+                "full byte raster this function promises never exists"
+            )
+        from .params import Params
+
+        engine.run(
+            Params(turns=turns, image_width=size, image_height=size),
+            None,
+            plane=plane,
+            initial_state=state,
+        )
+        state = engine.final_state()
+    elif turns:
         state = plane.step_n(state, turns)
     if out_path is not None:
         stream_packed_to_pgm(out_path, state, word_axis, row_block)

@@ -58,6 +58,14 @@ class InProcessBroker:
     Engine — the default backend when no remote server is given."""
 
     def __init__(self, engine: Engine | None = None):
+        if engine is not None and not engine.config.final_world:
+            # fail BEFORE a session runs for hours: this surface writes
+            # the final PGM from the decoded world
+            raise ValueError(
+                "the session controller needs a world-shipping engine "
+                "(final_world=True); final_world=False belongs to the "
+                "bigboard surface"
+            )
         self.engine = engine or Engine()
 
     def run(
@@ -234,6 +242,11 @@ def run(
         # join the ticker BEFORE the closing sequence so no stray
         # AliveCellsCount can interleave after StateChange{Quitting}
         ticker.stop()
+        if result.world is None:
+            raise ValueError(
+                "the session contract writes the final PGM from the world; "
+                "a final_world=False engine belongs to the bigboard surface"
+            )
         events.put(FinalTurnComplete(result.turns_completed, result.alive))
         write_board(result.world, params.output_filename, out_dir)
         events.put(

@@ -58,27 +58,40 @@ class Snapshot(NamedTuple):
 class RunResult:
     """What ``Operations.Run`` returns (broker/broker.go:228-230).
 
-    ``alive`` is derived from ``world`` on first access, so paths that only
-    ship the world (the RPC reply frames a count + world, never the cell
-    list) don't materialise O(alive) Python Cell objects — ~5M tuples for a
-    dense 4096^2 board."""
+    ``alive`` is derived on first access, so paths that never read the
+    cell list don't materialise O(alive) Python Cell objects — ~5M tuples
+    for a dense 4096^2 board. The derivation source is ``world`` when the
+    run decoded one, else the final plane state (a ``final_world=False``
+    run, where the byte raster must never exist: cells come from the
+    plane's sparse extraction)."""
 
-    __slots__ = ("turns_completed", "world", "_alive")
+    __slots__ = ("turns_completed", "world", "_alive", "_state", "_plane")
 
     def __init__(
         self,
         turns_completed: int,
-        world: np.ndarray,
+        world: Optional[np.ndarray],
         alive: Optional[List[Cell]] = None,
+        state=None,
+        plane=None,
     ):
         self.turns_completed = turns_completed
         self.world = world
         self._alive = alive
+        self._state = state
+        self._plane = plane
 
     @property
     def alive(self) -> List[Cell]:
         if self._alive is None:
-            self._alive = alive_cells(self.world)
+            if self.world is not None:
+                self._alive = alive_cells(self.world)
+            elif hasattr(self._plane, "alive_cells"):
+                self._alive = self._plane.alive_cells(self._state)
+            else:
+                # planes only implementing the documented duck-typed core
+                # (ops/plane.py:12-17) fall back through decode
+                self._alive = alive_cells(self._plane.decode(self._state))
         return self._alive
 
 
@@ -102,6 +115,10 @@ class EngineConfig:
     # the bitboard plane (pallas VMEM kernel under its VMEM gate) for
     # 32-divisible boards
     auto_fast: bool = True
+    # False: RunResult ships world=None and derives `alive` through the
+    # plane's sparse extraction instead of decoding the final board — the
+    # config-5 setting, where decoding would materialise a 4 GiB raster
+    final_world: bool = True
 
 
 class Engine:
@@ -160,13 +177,14 @@ class Engine:
     def run(
         self,
         params,
-        world: np.ndarray,
+        world: Optional[np.ndarray],
         *,
         emit: Optional[Callable] = None,
         emit_flips: bool = False,
         step_n_fn: Optional[Callable] = None,
         plane=None,
         initial_turn: int = 0,
+        initial_state=None,
     ) -> RunResult:
         """Blocking: evolve ``world`` for ``params.turns`` turns (or until
         quit). Resets the turn counter — a reattaching controller starts a
@@ -177,11 +195,37 @@ class Engine:
         ``CellFlipped`` for each changed cell before ``TurnComplete``
         (gol/event.go:50-60) — including the initial flips for cells alive
         in the loaded image.
+
+        ``initial_state`` starts the run from a state already in
+        ``plane``'s representation (``world`` must be None, ``plane``
+        explicit): the board never exists as bytes on entry — the
+        config-5 path, where the byte raster would be 4 GiB. Pair with
+        ``EngineConfig.final_world=False`` so the exit side stays
+        byte-free too.
         """
-        # defensive copy: the caller may reuse its buffer, and we hand this
-        # array out via retrieve()/emit_flips diffs
-        world = np.array(world, np.uint8, copy=True)
-        world.flags.writeable = False
+        if initial_state is not None:
+            if world is not None or emit_flips:
+                raise ValueError(
+                    "initial_state replaces world (pass world=None) and "
+                    "cannot emit per-cell flips"
+                )
+            if plane is None:
+                raise ValueError(
+                    "initial_state needs an explicit plane: the engine "
+                    "cannot infer the representation from a byte board"
+                )
+            if self.config.final_world:
+                raise ValueError(
+                    "initial_state requires EngineConfig(final_world=False): "
+                    "the default run exit would decode the full byte raster "
+                    "the packed entry exists to avoid (decode explicitly "
+                    "before run() if bytes are genuinely wanted)"
+                )
+        else:
+            # defensive copy: the caller may reuse its buffer, and we hand
+            # this array out via retrieve()/emit_flips diffs
+            world = np.array(world, np.uint8, copy=True)
+            world.flags.writeable = False
         with self._lock:
             if self._running:
                 raise RuntimeError("engine is already running")
@@ -189,12 +233,18 @@ class Engine:
             # per-run plane selection happens only after the already-running
             # check, so a rejected concurrent run can't clobber the active
             # run's representation
-            self._plane = self._choose_plane(
-                world.shape, step_n_fn, plane, emit_flips
-            )
-            self._state = self._plane.encode(world)
-            self._world_host = world
-            self._host_dirty = False
+            if initial_state is not None:
+                self._plane = plane
+                self._state = initial_state
+                self._world_host = None
+                self._host_dirty = True  # decode on demand (Retrieve world)
+            else:
+                self._plane = self._choose_plane(
+                    world.shape, step_n_fn, plane, emit_flips
+                )
+                self._state = self._plane.encode(world)
+                self._world_host = world
+                self._host_dirty = False
             # 0 for a fresh run (the reference's reset-on-Run semantics,
             # broker/broker.go:64); a checkpoint's turn for a resume
             self._turn = initial_turn
@@ -275,10 +325,13 @@ class Engine:
                     emit(TurnComplete(turn_now))
 
             with self._lock:
-                self._sync_host()
-                world_out = self._world_host
                 turns_done = self._turn
-            return RunResult(turns_done, world_out)
+                if self.config.final_world:
+                    self._sync_host()
+                    return RunResult(turns_done, self._world_host)
+                state_f, plane_f = self._state, self._plane
+            # lazy: .alive extracts from the plane state only if read
+            return RunResult(turns_done, None, state=state_f, plane=plane_f)
         finally:
             with self._lock:
                 self._running = False
@@ -325,6 +378,14 @@ class Engine:
     def super_quit_requested(self) -> bool:
         with self._lock:
             return self._super_quit
+
+    def final_state(self):
+        """The last run's state in its plane's representation (the
+        ``cWorld`` analogue without the decode): what a config-5 caller
+        streams to PGM (bigboard.stream_packed_to_pgm) after a
+        ``final_world=False`` run."""
+        with self._lock:
+            return self._state
 
     def retrieve(self, include_world: bool = True) -> Snapshot:
         """Mutex-guarded snapshot {World, TurnsCompleted, AliveCount}

@@ -63,6 +63,11 @@ class BytePlane:
 
         return int(alive_count(state))
 
+    def alive_cells(self, state):
+        from .reduce import alive_cells
+
+        return alive_cells(state)
+
 
 class BitPlane:
     """The int32 bitboard representation: 32 cells/word, state stays packed
@@ -126,3 +131,9 @@ class BitPlane:
         from .bitpack import alive_count_packed
 
         return alive_count_packed(state)
+
+    def alive_cells(self, state):
+        # sparse O(populated rows) extraction — no full unpack
+        from .bitpack import alive_cells_packed
+
+        return alive_cells_packed(state, self.word_axis)

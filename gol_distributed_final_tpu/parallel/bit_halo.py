@@ -262,6 +262,14 @@ class ShardedBitPlane:
 
         return alive_count_packed(state)
 
+    def alive_cells(self, state):
+        """Sparse O(populated-rows) cell extraction — single-host states
+        only (the Cell list is inherently host-side); multihost ranks use
+        decode_global + per-shard reads instead."""
+        from ..ops.bitpack import alive_cells_packed
+
+        return alive_cells_packed(state, self.word_axis)
+
 
 def make_bit_plane(
     mesh: Mesh, board_shape: tuple[int, int], rule: LifeRule = CONWAY

@@ -265,6 +265,11 @@ class BrokerService:
 
     def run(self, req: Request) -> Response:
         result = self.backend.run(req)
+        if result.world is None:
+            raise ValueError(
+                "the RPC Run contract ships the world; a final_world=False "
+                "engine belongs to the bigboard surface, not this broker"
+            )
         # alive stays empty on the wire, like retrieve() below: the client
         # derives cells from the world it already receives, instead of this
         # side pickling O(alive) Cell objects (~5M tuples for a dense 4096^2

@@ -140,3 +140,91 @@ def test_decode_window_bounds_and_axis1():
         decode_window(bitpack.pack(board, 0), 100, 0, 50, 10)
     with pytest.raises(ValueError, match="positive"):
         decode_window(bitpack.pack(board, 0), 100, 0, -50, 10)
+
+
+def test_alive_cells_packed_sparse_extraction():
+    """Sparse O(populated-rows) cell extraction matches the byte-plane
+    reduction — same cells, same row-major order — for both packings,
+    plus the empty board."""
+    from gol_distributed_final_tpu.ops import bitpack
+    from gol_distributed_final_tpu.ops.reduce import alive_cells
+
+    rng = np.random.default_rng(9)
+    board = np.where(rng.random((128, 160)) < 0.1, 255, 0).astype(np.uint8)
+    want = alive_cells(board)
+    for axis in (0, 1):
+        got = bitpack.alive_cells_packed(bitpack.pack(board, axis), axis)
+        assert got == want
+    assert bitpack.alive_cells_packed(bitpack.pack(np.zeros((64, 64), np.uint8), 0)) == []
+
+
+def test_engine_driven_big_board_with_control_plane(tmp_path):
+    """The config-5 control story: the engine evolves a packed board it
+    never decodes (final_world=False), the count-only Retrieve works
+    mid-run, the final cells come from sparse extraction, and the
+    streamed PGM matches the oracle window."""
+    import threading
+
+    from gol_distributed_final_tpu.engine import Engine
+    from gol_distributed_final_tpu.engine.engine import EngineConfig
+    from gol_distributed_final_tpu.bigboard import run_big_board
+    from gol_distributed_final_tpu.io.sharded import read_shard
+
+    eng = Engine(EngineConfig(final_world=False, min_chunk=4, max_chunk=16))
+    counts = []
+    stop = threading.Event()
+
+    def ticker():
+        while not stop.is_set():
+            counts.append(eng.retrieve(include_world=False).alive_count)
+
+    t = threading.Thread(target=ticker)
+    t.start()
+    out = tmp_path / "eng.pgm"
+    alive = run_big_board(
+        SIZE, TURNS, out, cells=r_pentomino(SIZE), row_block=512, engine=eng
+    )
+    stop.set()
+    t.join(30)
+    window = oracle_window()
+    assert alive == int(np.count_nonzero(window))
+    got = read_shard(out, W0, W0 + WIN)[:, W0 : W0 + WIN]
+    np.testing.assert_array_equal(got, window)
+    assert counts, "count-only retrieve must work mid-run"
+
+
+def test_initial_state_requires_plane_and_no_world():
+    from gol_distributed_final_tpu.engine import Engine
+    from gol_distributed_final_tpu.engine.engine import EngineConfig
+    from gol_distributed_final_tpu.ops import bitpack
+    from gol_distributed_final_tpu.ops.plane import BitPlane
+    from gol_distributed_final_tpu.params import Params
+
+    state = bitpack.pack(np.zeros((64, 64), np.uint8), 0)
+    eng = Engine(EngineConfig())
+    p = Params(turns=1, image_width=64, image_height=64)
+    with pytest.raises(ValueError, match="explicit plane"):
+        eng.run(p, None, initial_state=state)
+    with pytest.raises(ValueError, match="world=None"):
+        eng.run(p, np.zeros((64, 64), np.uint8), plane=BitPlane(), initial_state=state)
+
+
+def test_final_alive_from_sparse_extraction_matches_golden():
+    """final_world=False must produce the same FinalTurnComplete payload
+    as the decoding path, cells included."""
+    from gol_distributed_final_tpu.engine import Engine
+    from gol_distributed_final_tpu.engine.engine import EngineConfig
+    from gol_distributed_final_tpu.io.pgm import read_pgm
+    from gol_distributed_final_tpu.ops import bitpack
+    from gol_distributed_final_tpu.ops.plane import BitPlane
+    from gol_distributed_final_tpu.params import Params
+    from helpers import REPO_ROOT
+
+    board = read_pgm(REPO_ROOT / "images" / "64x64.pgm")
+    p = Params(turns=100, image_width=64, image_height=64)
+    ref = Engine(EngineConfig()).run(p, board)
+    res = Engine(EngineConfig(final_world=False)).run(
+        p, None, plane=BitPlane(), initial_state=bitpack.pack(board, 0)
+    )
+    assert res.world is None
+    assert res.alive == ref.alive
