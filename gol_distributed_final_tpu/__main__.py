@@ -44,7 +44,14 @@ def main(argv=None) -> int:
     parser.add_argument(
         "-server", default="", help="broker address (empty: in-process engine)"
     )
+    parser.add_argument(
+        "-resume", default=None, metavar="CKPT",
+        help="continue from an engine/checkpoint.py .npz instead of "
+             "images/<W>x<H>.pgm at turn 0 (in-process engine only)",
+    )
     args = parser.parse_args(argv)
+    if args.resume and args.server:
+        parser.error("-resume needs the in-process engine (no -server)")
 
     from . import Params, run
     from .engine.controller import iter_events
@@ -98,7 +105,8 @@ def main(argv=None) -> int:
         # the in-process engine can feed the visualiser per-cell flips; the
         # remote path (like the reference's distributed mode) cannot
         emit_flips = not args.noVis and broker is None
-        run(params, events, keypresses, broker=broker, emit_flips=emit_flips)
+        run(params, events, keypresses, broker=broker,
+            emit_flips=emit_flips, resume_from=args.resume)
     finally:
         done.set()
         consumer.join()

@@ -212,6 +212,19 @@ def run(
         from .checkpoint import load_checkpoint
 
         ckpt_world, initial_turn, ckpt_rule = load_checkpoint(resume_from)
+        if ckpt_world.shape != (params.image_height, params.image_width):
+            raise ValueError(
+                f"checkpoint board is {ckpt_world.shape[1]}x"
+                f"{ckpt_world.shape[0]} but params say "
+                f"{params.image_width}x{params.image_height}: the output "
+                "filename and visualiser window would mislabel the board"
+            )
+        if params.turns <= initial_turn:
+            raise ValueError(
+                f"turns={params.turns} is not beyond the checkpoint's "
+                f"turn {initial_turn}: nothing would run, yet the output "
+                f"would be named ...x{params.turns}.pgm"
+            )
 
     if events is None:
         events = queue.Queue()

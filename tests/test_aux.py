@@ -158,3 +158,69 @@ def test_checkpoint_format_cross_loading_raises(tmp_path):
         load_checkpoint(packp)
     with pytest.raises(ValueError, match="byte-board checkpoint"):
         load_packed_checkpoint(bytep)
+
+
+def test_cli_resume_session(tmp_path, monkeypatch):
+    """`python -m gol_distributed_final_tpu -resume ck.npz`: the session
+    continues from the checkpoint turn and the final PGM matches the
+    uninterrupted golden (the reference always restarts at turn 0 —
+    SURVEY.md §5; resume is the added capability, now on the CLI)."""
+    import subprocess
+    import sys
+
+    from gol_distributed_final_tpu.engine import Engine, save_checkpoint
+    from gol_distributed_final_tpu.engine.engine import EngineConfig
+    from gol_distributed_final_tpu.io.pgm import read_pgm
+    from gol_distributed_final_tpu.params import Params
+
+    board = read_pgm(REPO_ROOT / "images" / "64x64.pgm")
+    mid = Engine(EngineConfig()).run(
+        Params(turns=40, image_width=64, image_height=64), board
+    )
+    ck = save_checkpoint(tmp_path / "ck.npz", mid.world, 40)
+    import os
+
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=str(REPO_ROOT),
+    )
+    # no images/ in the scratch dir: resume must not need the input PGM
+    r = subprocess.run(
+        [sys.executable, "-m", "gol_distributed_final_tpu",
+         "-w", "64", "-h", "64", "-turns", "100", "-noVis",
+         "-resume", str(ck)],
+        capture_output=True, text=True, timeout=240, env=env, cwd=tmp_path,
+    )
+    assert r.returncode == 0, r.stderr[-500:]
+    raw = (tmp_path / "out" / "64x64x100.pgm").read_bytes()
+    golden = (REPO_ROOT / "check" / "images" / "64x64x100.pgm").read_bytes()
+    assert raw[raw.index(b"255\n") + 4:] == golden[golden.index(b"255\n") + 4:]
+
+    # -resume is in-process only: combining with -server must error out
+    r2 = subprocess.run(
+        [sys.executable, "-m", "gol_distributed_final_tpu",
+         "-resume", str(ck), "-server", "127.0.0.1:1", "-noVis"],
+        capture_output=True, text=True, timeout=60, env=env, cwd=tmp_path,
+    )
+    assert r2.returncode != 0 and "in-process" in r2.stderr
+
+
+def test_resume_validates_shape_and_turns(tmp_path):
+    """Mismatched params would mislabel the output PGM / visualiser
+    window; turns at or below the checkpoint turn would run nothing under
+    a contradicting filename. Both rejected up front."""
+    import queue
+
+    from gol_distributed_final_tpu import run
+    from gol_distributed_final_tpu.engine import save_checkpoint
+    from gol_distributed_final_tpu.params import Params
+
+    board = np.zeros((64, 64), np.uint8)
+    ck = save_checkpoint(tmp_path / "ck.npz", board, 40)
+    with pytest.raises(ValueError, match="mislabel"):
+        run(Params(turns=100, image_width=128, image_height=128),
+            queue.Queue(), None, resume_from=ck)
+    with pytest.raises(ValueError, match="not beyond"):
+        run(Params(turns=40, image_width=64, image_height=64),
+            queue.Queue(), None, resume_from=ck)
