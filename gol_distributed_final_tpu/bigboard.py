@@ -167,20 +167,10 @@ def run_big_board(
     mid-run on a board whose byte raster will never exist, closing the
     gap between the reference's control surface (broker/broker.go:236-277)
     and config-5 scale."""
-    if (cells is None) == (in_path is None):
-        raise ValueError("exactly one of cells / in_path must be given")
-    if cells is not None:
-        state = seed_packed(size, cells, word_axis)
-    else:
-        state = load_packed_from_pgm(in_path, word_axis, row_block)
+    state = _seed_state(size, cells, in_path, word_axis, row_block)
     plane = BitPlane(rule, word_axis)
     if engine is not None:
-        if engine.config.final_world:
-            raise ValueError(
-                "run_big_board needs an Engine(EngineConfig("
-                "final_world=False)): the default run exit decodes the "
-                "full byte raster this function promises never exists"
-            )
+        _check_byte_free_engine(engine)
         from .params import Params
 
         engine.run(
@@ -195,6 +185,131 @@ def run_big_board(
     if out_path is not None:
         stream_packed_to_pgm(out_path, state, word_axis, row_block)
     return alive_count_packed(state)
+
+
+def _seed_state(size, cells, in_path, word_axis, row_block):
+    if (cells is None) == (in_path is None):
+        raise ValueError("exactly one of cells / in_path must be given")
+    if cells is not None:
+        return seed_packed(size, cells, word_axis)
+    return load_packed_from_pgm(in_path, word_axis, row_block)
+
+
+def _check_byte_free_engine(engine) -> None:
+    if engine.config.final_world:
+        raise ValueError(
+            "big-board runs need an Engine(EngineConfig(final_world="
+            "False)): the default run exit decodes the full byte raster "
+            "this surface promises never exists"
+        )
+
+
+class _PackedBroker:
+    """The slice of the stubs verb surface the ticker needs, served by an
+    engine holding a packed state. ``retrieve`` is always count-only —
+    the PGM snapshot path streams from the packed state instead of ever
+    decoding a world."""
+
+    def __init__(self, engine):
+        self.engine = engine
+
+    def retrieve(self, include_world: bool = True):
+        return self.engine.retrieve(include_world=False)
+
+    def pause(self):
+        return self.engine.pause()
+
+    def quit(self):
+        return self.engine.quit()
+
+    def super_quit(self):
+        return self.engine.super_quit()
+
+
+def big_session(
+    size: int,
+    turns: int,
+    *,
+    cells: Sequence[tuple[int, int]] | None = None,
+    in_path=None,
+    rule: LifeRule = CONWAY,
+    word_axis: int = 0,
+    row_block: int = 1024,
+    engine=None,
+    events=None,
+    keypresses=None,
+    tick_seconds: float = 2.0,
+    out_dir="out",
+):
+    """The FULL reference session surface over a packed big board: the
+    2-second ``AliveCellsCount`` ticker, the ``s``/``q``/``k``/``p``
+    keyboard semantics (gol/distributor.go:61-122), and the closing
+    ``FinalTurnComplete`` -> PGM -> ``ImageOutputComplete`` ->
+    ``StateChange{Quitting}`` -> CLOSED sequence — on a board whose byte
+    raster never exists (snapshots stream row blocks; cells come from
+    sparse extraction). Returns the engine's RunResult.
+
+    The byte-session equivalent is ``engine.controller.run``; this is its
+    config-5 sibling, sharing the same ticker implementation."""
+    import pathlib
+    import queue as queue_mod
+
+    from .engine.controller import CLOSED, _Ticker
+    from .engine.engine import Engine, EngineConfig
+    from .events import (
+        FinalTurnComplete,
+        ImageOutputComplete,
+        Quitting,
+        StateChange,
+    )
+    from .params import Params
+
+    if engine is None:
+        engine = Engine(EngineConfig(final_world=False))
+    else:
+        _check_byte_free_engine(engine)  # before seeding/threads, not deep
+        # inside engine.run after the ticker is already up
+    if events is None:
+        events = queue_mod.Queue()
+    params = Params(turns=turns, image_width=size, image_height=size)
+    state = _seed_state(size, cells, in_path, word_axis, row_block)
+    plane = BitPlane(rule, word_axis)
+    out_file = pathlib.Path(out_dir) / f"{params.output_filename}.pgm"
+
+    class _BigTicker(_Ticker):
+        def _snapshot_to_pgm(self):
+            from .engine.engine import Snapshot
+
+            # state and turn under ONE lock: a retrieve + final_state
+            # pair could straddle a chunk commit and disagree by up to
+            # max_chunk turns between the reported turn and the PGM
+            current, turn = self.broker.engine.state_snapshot()
+            if current is not None:
+                stream_packed_to_pgm(out_file, current, word_axis, row_block)
+            count = alive_count_packed(current) if current is not None else 0
+            return Snapshot(None, turn, count)
+
+    ticker = _BigTicker(
+        params, events, keypresses, _PackedBroker(engine), out_dir, tick_seconds
+    )
+    ticker.start()
+    try:
+        result = engine.run(params, None, plane=plane, initial_state=state)
+        ticker.stop()
+        events.put(FinalTurnComplete(result.turns_completed, result.alive))
+        final = engine.final_state()
+        if final is not None:
+            stream_packed_to_pgm(out_file, final, word_axis, row_block)
+        events.put(
+            ImageOutputComplete(result.turns_completed, params.output_filename)
+        )
+        events.put(StateChange(result.turns_completed, Quitting))
+        return result
+    finally:
+        ticker.stop()
+        # consumers drain until CLOSED (controller.py does the same in
+        # its finally): an error path must not leave them blocked
+        events.put(CLOSED)
 
 
 def main(argv=None) -> int:

@@ -380,12 +380,19 @@ class Engine:
             return self._super_quit
 
     def final_state(self):
-        """The last run's state in its plane's representation (the
-        ``cWorld`` analogue without the decode): what a config-5 caller
-        streams to PGM (bigboard.stream_packed_to_pgm) after a
-        ``final_world=False`` run."""
+        """The current/last state in its plane's representation (the
+        ``cWorld`` analogue without the decode): the latest committed
+        chunk mid-run, the final board after. What a config-5 caller
+        streams to PGM (bigboard.stream_packed_to_pgm)."""
         with self._lock:
             return self._state
+
+    def state_snapshot(self):
+        """``(state, turns_completed)`` under ONE lock acquisition: a
+        consistent pair for packed snapshots (two separate reads could
+        straddle a chunk commit and disagree by up to max_chunk turns)."""
+        with self._lock:
+            return self._state, self._turn
 
     def retrieve(self, include_world: bool = True) -> Snapshot:
         """Mutex-guarded snapshot {World, TurnsCompleted, AliveCount}

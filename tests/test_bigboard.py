@@ -228,3 +228,61 @@ def test_final_alive_from_sparse_extraction_matches_golden():
     )
     assert res.world is None
     assert res.alive == ref.alive
+
+
+def test_big_session_full_event_surface(tmp_path):
+    """The reference session contract at big-board scale: AliveCellsCount
+    ticks, 's' snapshot mid-run, pause/resume StateChanges with the
+    turn-minus-one resume quirk, and the exact closing sequence — all on
+    a board that never exists as bytes."""
+    import queue
+
+    from gol_distributed_final_tpu.bigboard import big_session
+    from gol_distributed_final_tpu.engine.controller import CLOSED
+    from gol_distributed_final_tpu.events import (
+        AliveCellsCount,
+        FinalTurnComplete,
+        ImageOutputComplete,
+        Quitting,
+        State,
+        StateChange,
+    )
+
+    events: "queue.Queue" = queue.Queue()
+    keys: "queue.Queue" = queue.Queue()
+    keys.put("s")
+    keys.put("p")
+    keys.put("p")
+    res = big_session(
+        SIZE,
+        TURNS,
+        cells=r_pentomino(SIZE),
+        row_block=512,
+        events=events,
+        keypresses=keys,
+        tick_seconds=0.1,
+        out_dir=tmp_path,
+    )
+    seq = []
+    while True:
+        ev = events.get(timeout=60)
+        if ev is CLOSED:
+            break
+        seq.append(ev)
+    window = oracle_window()
+    final = [e for e in seq if isinstance(e, FinalTurnComplete)]
+    assert len(final) == 1 and res.turns_completed == TURNS
+    assert len(final[0].alive) == int(np.count_nonzero(window))
+    assert any(isinstance(e, AliveCellsCount) for e in seq)
+    states = [e.new_state for e in seq if isinstance(e, StateChange)]
+    assert states[:2] == [State.PAUSED, State.EXECUTING]
+    assert states[-1] is Quitting
+    assert isinstance(seq[-2], ImageOutputComplete)
+    # the streamed output PGM window matches the oracle
+    got = read_shard(
+        tmp_path / f"{SIZE}x{SIZE}x{TURNS}.pgm", W0, W0 + WIN
+    )[:, W0 : W0 + WIN]
+    np.testing.assert_array_equal(got, window)
+    # the 's' snapshot wrote the same file mid-run (overwritten at end);
+    # the run result's world never materialised
+    assert res.world is None
