@@ -22,13 +22,49 @@ import threading
 def _stdin_keys(keypresses: "queue.Queue", done: threading.Event) -> None:
     """Forward raw single-key presses (s/q/k/p) from a TTY.
 
-    The terminal mode is saved/restored by main(), not here: this daemon
-    thread dies blocked in read(1) at process exit, so its finally would
-    never run."""
+    The terminal mode is saved/restored by the caller, not here: this
+    daemon thread dies blocked in read(1) at process exit, so its finally
+    would never run."""
     while not done.is_set():
         ch = sys.stdin.read(1)
         if ch in ("s", "q", "k", "p"):
             keypresses.put(ch)
+
+
+def start_tty_keys(keypresses: "queue.Queue"):
+    """Put the terminal in cbreak mode and forward s/q/k/p keys; returns
+    a restore() callable (a no-op off-tty). Shared by the controller CLI
+    and the bigboard session CLI."""
+    if not sys.stdin.isatty():
+        return lambda: None
+    import termios
+    import tty
+
+    fd = sys.stdin.fileno()
+    old = termios.tcgetattr(fd)
+    tty.setcbreak(fd)
+    done = threading.Event()
+    threading.Thread(
+        target=_stdin_keys, args=(keypresses, done), daemon=True
+    ).start()
+
+    def restore():
+        done.set()
+        termios.tcsetattr(fd, termios.TCSADRAIN, old)
+
+    return restore
+
+
+def drain_events(events: "queue.Queue") -> None:
+    """Headless consumer (main.go:59-67's -noVis drain): print every event
+    with a non-empty string as ``Completed Turns <n> <event>`` until the
+    CLOSED sentinel."""
+    from .engine.controller import iter_events
+
+    for ev in iter_events(events):
+        text = str(ev)
+        if text:
+            print(f"Completed Turns {ev.get_completed_turns()} {text}")
 
 
 def main(argv=None) -> int:
@@ -54,7 +90,6 @@ def main(argv=None) -> int:
         parser.error("-resume needs the in-process engine (no -server)")
 
     from . import Params, run
-    from .engine.controller import iter_events
 
     params = Params(
         turns=args.turns, threads=args.t, image_width=args.w, image_height=args.h
@@ -69,29 +104,14 @@ def main(argv=None) -> int:
 
     events: "queue.Queue" = queue.Queue()
     keypresses: "queue.Queue" = queue.Queue()
-    done = threading.Event()
 
-    old_termios = None
-    if sys.stdin.isatty() and not args.noVis:
-        import termios
-        import tty
-
-        fd = sys.stdin.fileno()
-        old_termios = termios.tcgetattr(fd)
-        tty.setcbreak(fd)
-        threading.Thread(
-            target=_stdin_keys, args=(keypresses, done), daemon=True
-        ).start()
+    restore_tty = (
+        start_tty_keys(keypresses) if not args.noVis else (lambda: None)
+    )
 
     if args.noVis:
         # headless drain (main.go:59-67)
-        def consume():
-            for ev in iter_events(events):
-                text = str(ev)
-                if text:
-                    print(f"Completed Turns {ev.get_completed_turns()} {text}")
-
-        consumer = threading.Thread(target=consume)
+        consumer = threading.Thread(target=drain_events, args=(events,))
     else:
         # visualiser loop (main.go:57, sdl.Run); headless window fallback
         # when the native SDL backend isn't built
@@ -108,14 +128,8 @@ def main(argv=None) -> int:
         run(params, events, keypresses, broker=broker,
             emit_flips=emit_flips, resume_from=args.resume)
     finally:
-        done.set()
         consumer.join()
-        if old_termios is not None:
-            import termios
-
-            termios.tcsetattr(
-                sys.stdin.fileno(), termios.TCSADRAIN, old_termios
-            )
+        restore_tty()
     return 0
 
 

@@ -264,38 +264,48 @@ def big_session(
     )
     from .params import Params
 
-    if engine is None:
-        engine = Engine(EngineConfig(final_world=False))
-    else:
-        _check_byte_free_engine(engine)  # before seeding/threads, not deep
-        # inside engine.run after the ticker is already up
     if events is None:
         events = queue_mod.Queue()
-    params = Params(turns=turns, image_width=size, image_height=size)
-    state = _seed_state(size, cells, in_path, word_axis, row_block)
-    plane = BitPlane(rule, word_axis)
-    out_file = pathlib.Path(out_dir) / f"{params.output_filename}.pgm"
-
-    class _BigTicker(_Ticker):
-        def _snapshot_to_pgm(self):
-            from .engine.engine import Snapshot
-
-            # state and turn under ONE lock: a retrieve + final_state
-            # pair could straddle a chunk commit and disagree by up to
-            # max_chunk turns between the reported turn and the PGM
-            current, turn = self.broker.engine.state_snapshot()
-            if current is not None:
-                stream_packed_to_pgm(out_file, current, word_axis, row_block)
-            count = alive_count_packed(current) if current is not None else 0
-            return Snapshot(None, turn, count)
-
-    ticker = _BigTicker(
-        params, events, keypresses, _PackedBroker(engine), out_dir, tick_seconds
-    )
-    ticker.start()
+    ticker = None
     try:
-        result = engine.run(params, None, plane=plane, initial_state=state)
-        ticker.stop()
+        # EVERYTHING (validation and seeding included) sits inside the
+        # CLOSED guard: an error anywhere must not leave a consumer
+        # blocked on the queue (controller.py gives the same guarantee)
+        if engine is None:
+            engine = Engine(EngineConfig(final_world=False))
+        else:
+            _check_byte_free_engine(engine)
+        params = Params(turns=turns, image_width=size, image_height=size)
+        state = _seed_state(size, cells, in_path, word_axis, row_block)
+        plane = BitPlane(rule, word_axis)
+        out_file = pathlib.Path(out_dir) / f"{params.output_filename}.pgm"
+
+        class _BigTicker(_Ticker):
+            def _snapshot_to_pgm(self):
+                from .engine.engine import Snapshot
+
+                # state and turn under ONE lock: a retrieve + final_state
+                # pair could straddle a chunk commit and disagree by up to
+                # max_chunk turns between the reported turn and the PGM
+                current, turn = self.broker.engine.state_snapshot()
+                if current is not None:
+                    stream_packed_to_pgm(
+                        out_file, current, word_axis, row_block
+                    )
+                count = alive_count_packed(current) if current is not None else 0
+                return Snapshot(None, turn, count)
+
+        ticker = _BigTicker(
+            params, events, keypresses, _PackedBroker(engine), out_dir,
+            tick_seconds,
+        )
+        ticker.start()
+        try:
+            result = engine.run(
+                params, None, plane=plane, initial_state=state
+            )
+        finally:
+            ticker.stop()
         events.put(FinalTurnComplete(result.turns_completed, result.alive))
         final = engine.final_state()
         if final is not None:
@@ -306,9 +316,6 @@ def big_session(
         events.put(StateChange(result.turns_completed, Quitting))
         return result
     finally:
-        ticker.stop()
-        # consumers drain until CLOSED (controller.py does the same in
-        # its finally): an error path must not leave them blocked
         events.put(CLOSED)
 
 
@@ -322,8 +329,46 @@ def main(argv=None) -> int:
     parser.add_argument("-in", dest="in_path", default=None,
                         help="seed from a PGM instead of the R-pentomino")
     parser.add_argument("-row-block", type=int, default=1024)
+    parser.add_argument(
+        "-session", action="store_true", default=False,
+        help="run through big_session: 2 s alive-count ticker, s/q/k/p "
+             "keys on stdin (tty), events printed like the headless drain",
+    )
     args = parser.parse_args(argv)
     cells = None if args.in_path else r_pentomino(args.size)
+    if args.session:
+        import pathlib
+        import queue as queue_mod
+        import threading
+
+        from .__main__ import drain_events, start_tty_keys
+
+        events: "queue_mod.Queue" = queue_mod.Queue()
+        keypresses: "queue_mod.Queue" = queue_mod.Queue()
+        restore_tty = start_tty_keys(keypresses)
+        consumer = threading.Thread(target=drain_events, args=(events,))
+        consumer.start()
+        try:
+            # sessions name the file by the reference convention inside
+            # -out's directory; honor the exact -out basename with a
+            # final rename so both modes mean the same thing by -out
+            out_path = pathlib.Path(args.out)
+            result = big_session(
+                args.size, args.turns, cells=cells, in_path=args.in_path,
+                row_block=args.row_block, events=events,
+                keypresses=keypresses, out_dir=out_path.parent,
+            )
+            conventional = (
+                out_path.parent
+                / f"{args.size}x{args.size}x{args.turns}.pgm"
+            )
+            if conventional.exists() and conventional != out_path:
+                conventional.replace(out_path)
+        finally:
+            consumer.join()
+            restore_tty()
+        print(f"alive {len(result.alive)}")
+        return 0
     alive = run_big_board(
         args.size, args.turns, args.out,
         cells=cells, in_path=args.in_path, row_block=args.row_block,

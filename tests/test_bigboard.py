@@ -286,3 +286,26 @@ def test_big_session_full_event_surface(tmp_path):
     # the 's' snapshot wrote the same file mid-run (overwritten at end);
     # the run result's world never materialised
     assert res.world is None
+
+
+def test_cli_session_smoke(tmp_path):
+    """`python -m gol_distributed_final_tpu.bigboard -session`: events
+    print in the reference's `Completed Turns <n> <event>` form and the
+    streamed PGM lands under the -out directory."""
+    import os
+    import subprocess
+    import sys
+
+    from helpers import REPO_ROOT
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO_ROOT))
+    r = subprocess.run(
+        [sys.executable, "-m", "gol_distributed_final_tpu.bigboard",
+         "-session", "-size", "2048", "-turns", "50",
+         "-out", str(tmp_path / "x.pgm"), "-row-block", "512"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=tmp_path,
+    )
+    assert r.returncode == 0, r.stderr[-500:]
+    assert "Quitting" in r.stdout and "alive " in r.stdout
+    # -session honors the exact -out path, same as batch mode
+    assert (tmp_path / "x.pgm").exists()
