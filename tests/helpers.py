@@ -33,3 +33,25 @@ def assert_equal_board(given, expected, width, height):
         if width <= 16 and height <= 16:
             msg += "\n" + alive_cells_to_string(given, expected, width, height)
         raise AssertionError(msg)
+
+
+def oracle_window(size: int, turns: int, win: int, cells=None):
+    """Exact evolution of the populated centre window of a big sparse
+    board (default seed: the centred R-pentomino). Valid while the
+    pattern's envelope stays inside the window — the caller picks `win`
+    with margin (an R-pentomino's 100-turn envelope fits 512^2 easily)."""
+    import numpy as np
+
+    from oracle import vector_step
+
+    if cells is None:
+        from gol_distributed_final_tpu.bigboard import r_pentomino
+
+        cells = r_pentomino(size)
+    w0 = size // 2 - win // 2
+    window = np.zeros((win, win), np.uint8)
+    for x, y in cells:
+        window[y - w0, x - w0] = 255
+    for _ in range(turns):
+        window = vector_step(window)
+    return window

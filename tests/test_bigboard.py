@@ -32,13 +32,10 @@ W0 = SIZE // 2 - WIN // 2
 
 def oracle_window(turns=TURNS):
     """The centre window evolved exactly (the envelope never reaches its
-    edge, so no wrap effects)."""
-    window = np.zeros((WIN, WIN), np.uint8)
-    for x, y in r_pentomino(SIZE):
-        window[y - W0, x - W0] = 255
-    for _ in range(turns):
-        window = vector_step(window)
-    return window
+    edge, so no wrap effects). Shared logic in helpers.oracle_window."""
+    from helpers import oracle_window as _ow
+
+    return _ow(SIZE, turns, WIN)
 
 
 def test_big_board_streamed_run_matches_oracle(tmp_path):

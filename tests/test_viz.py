@@ -76,3 +76,38 @@ def test_make_window_falls_back_headless():
 
     w = make_window(4, 4)
     assert isinstance(w, Window)  # no libSDL2 in this image
+
+
+def test_bigview_tracks_engine_session():
+    """The config-5 visualiser: a BigView watching an engine-driven big
+    board renders the oracle window through the reference SetPixel
+    protocol — live while the session runs, exact after it ends."""
+    from gol_distributed_final_tpu.bigboard import r_pentomino
+    from gol_distributed_final_tpu.engine import Engine
+    from gol_distributed_final_tpu.engine.engine import EngineConfig
+    from gol_distributed_final_tpu.viz.bigview import BigView
+
+    from helpers import oracle_window
+
+    SIZE, TURNS, WIN = 2048, 60, 256
+    W0 = SIZE // 2 - WIN // 2
+    eng = Engine(EngineConfig(final_world=False, min_chunk=2, max_chunk=8))
+    view = BigView(
+        eng, W0, W0, WIN, WIN, window=Window(WIN, WIN), interval=0.05
+    ).watch()
+    # run via the engine directly so no PGM lands in the repo out/
+    from gol_distributed_final_tpu.bigboard import seed_packed
+    from gol_distributed_final_tpu.ops.plane import BitPlane
+    from gol_distributed_final_tpu.params import Params
+
+    state = seed_packed(SIZE, r_pentomino(SIZE))
+    eng.run(
+        Params(turns=TURNS, image_width=SIZE, image_height=SIZE),
+        None, plane=BitPlane(), initial_state=state,
+    )
+    view.stop()  # re-raises if the watch thread died
+    assert view.live_frames >= 1, "no frame rendered WHILE the run was live"
+    assert view.refresh()  # one final frame from the settled state
+    oracle = oracle_window(SIZE, TURNS, WIN)
+    np.testing.assert_array_equal((view.window._pixels != 0), oracle != 0)
+    assert view.last_turn == TURNS
