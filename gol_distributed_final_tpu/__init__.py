@@ -18,8 +18,10 @@ Package layout:
     io/        PGM P5 codec, images/ -> out/ conventions, streamed shard IO
     events/    the 6-event observability stream
     rpc/       TCP control plane preserving the stubs/ method vocabulary
-    viz/       visualiser (SDL-equivalent) with headless fallback
+    viz/       visualiser (SDL-equivalent) with headless fallback + BigView
     utils/     Cell, board visualisation for test failures
+    bigboard   BASELINE config 5: packed-only boards up to 65536^2 —
+               sparse seeding, streamed PGM, decode_window, big_session
 """
 
 from .params import Params
