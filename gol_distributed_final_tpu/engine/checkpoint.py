@@ -22,15 +22,20 @@ import numpy as np
 from ..models import CONWAY, LifeRule
 
 
+def npz_path(path) -> pathlib.Path:
+    """The path ``np.savez_compressed`` actually writes: ``.npz`` is
+    appended whenever the name doesn't already end with it (so e.g.
+    ``ck.backup`` lands at ``ck.backup.npz``)."""
+    path = pathlib.Path(path)
+    return path if path.suffix == ".npz" else path.with_name(path.name + ".npz")
+
+
 def _save_npz(path, **arrays) -> pathlib.Path:
-    """Write a compressed npz, returning the path actually written:
-    ``np.savez_compressed`` appends ``.npz`` whenever the name doesn't
-    already end with it (so e.g. ``ck.backup`` lands at
-    ``ck.backup.npz``)."""
+    """Write a compressed npz, returning the path actually written."""
     path = pathlib.Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     np.savez_compressed(path, **arrays)
-    return path if path.suffix == ".npz" else path.with_name(path.name + ".npz")
+    return npz_path(path)
 
 
 def save_checkpoint(path, world, turn: int, rule: LifeRule = CONWAY) -> pathlib.Path:

@@ -119,6 +119,13 @@ class EngineConfig:
     # plane's sparse extraction instead of decoding the final board — the
     # config-5 setting, where decoding would materialise a 4 GiB raster
     final_world: bool = True
+    # periodic crash-recovery checkpoints: every time the turn counter
+    # crosses a multiple of checkpoint_every, the committed state is
+    # written to checkpoint_path between chunk dispatches (packed .npz
+    # for bitboard planes — no decode — else the byte format). The
+    # reference has only the manual 's' snapshot (gol/distributor.go:78).
+    checkpoint_every: int = 0  # 0: disabled
+    checkpoint_path: Optional[str] = None
 
 
 class Engine:
@@ -324,6 +331,10 @@ class Engine:
                         emit(CellFlipped(turn_now, Cell(int(x), int(y))))
                     emit(TurnComplete(turn_now))
 
+                every = self.config.checkpoint_every
+                if every and turn_now // every > (turn_now - n) // every:
+                    self._write_checkpoint(new_state, turn_now)
+
             with self._lock:
                 turns_done = self._turn
                 if self.config.final_world:
@@ -339,6 +350,34 @@ class Engine:
                 self._quit = False  # consumed; a reattached run starts fresh
                 # _plane/_state stay: Retrieve keeps serving the final board
                 self._control.notify_all()
+
+    def _write_checkpoint(self, state, turn: int) -> None:
+        """Periodic crash-recovery checkpoint, between chunk dispatches.
+
+        Bitboard-plane states go down packed — no decode, the config-5
+        requirement — anything else through the byte format. Written to a
+        temp name then atomically renamed, so a crash mid-write leaves
+        the previous checkpoint intact."""
+        import pathlib
+
+        from .checkpoint import npz_path, save_checkpoint, save_packed_checkpoint
+
+        if not getattr(state, "is_fully_addressable", True):
+            # multi-host global states can't materialise on one rank, and
+            # every rank writing the same path would clash — periodic
+            # checkpointing is a single-host feature for now
+            return
+        # the ACTIVE plane's rule, not the config's: an explicit
+        # plane=BitPlane(HIGHLIFE) run must not stamp a Conway checkpoint
+        rule = getattr(self._plane, "rule", self.config.rule)
+        path = pathlib.Path(self.config.checkpoint_path or "out/engine_ck.npz")
+        tmp = path.with_name(path.name + ".tmp")
+        word_axis = getattr(self._plane, "word_axis", None)
+        if word_axis is not None and hasattr(state, "dtype") and state.dtype == np.int32:
+            written = save_packed_checkpoint(tmp, state, turn, rule, word_axis)
+        else:
+            written = save_checkpoint(tmp, self._plane.decode(state), turn, rule)
+        written.replace(npz_path(path))
 
     # -- control plane (broker/broker.go:236-277) -------------------------
 

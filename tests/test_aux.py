@@ -224,3 +224,84 @@ def test_resume_validates_shape_and_turns(tmp_path):
     with pytest.raises(ValueError, match="not beyond"):
         run(Params(turns=40, image_width=64, image_height=64),
             queue.Queue(), None, resume_from=ck)
+
+
+def test_periodic_auto_checkpoint_and_recovery(tmp_path):
+    """EngineConfig(checkpoint_every=...) writes crash-recovery
+    checkpoints between chunks (packed for bitboard planes — no decode);
+    resuming from the last one reproduces the uninterrupted run."""
+    import numpy as np
+
+    from gol_distributed_final_tpu.engine import (
+        Engine,
+        load_packed_checkpoint,
+    )
+    from gol_distributed_final_tpu.engine.engine import EngineConfig
+    from gol_distributed_final_tpu.io.pgm import read_pgm
+    from gol_distributed_final_tpu.ops import bitpack
+    from gol_distributed_final_tpu.ops.plane import BitPlane
+    from gol_distributed_final_tpu.params import Params
+
+    board = read_pgm(REPO_ROOT / "images" / "64x64.pgm")
+    ck = tmp_path / "auto.npz"
+    cfg = EngineConfig(
+        final_world=False,
+        min_chunk=10,
+        max_chunk=10,
+        checkpoint_every=30,
+        checkpoint_path=str(ck),
+    )
+    Engine(cfg).run(
+        Params(turns=100, image_width=64, image_height=64),
+        None,
+        plane=BitPlane(),
+        initial_state=bitpack.pack(board, 0),
+    )
+    packed, turn, rule, word_axis = load_packed_checkpoint(ck)
+    # chunks pinned to 10: crossings at 30, 60, 90; the file holds the
+    # LAST mid-run overwrite — exactly 90, never the run-end turn (a
+    # checkpoint-only-at-completion regression must fail here)
+    assert turn == 90 and rule.rulestring == "B3/S23"
+    resumed = bitpack.bit_step_n(packed, 100 - turn, word_axis)
+    straight = bitpack.bit_step_n(bitpack.pack(board, 0), 100, 0)
+    np.testing.assert_array_equal(np.asarray(resumed), np.asarray(straight))
+
+    # byte-plane path: decoded checkpoint, loadable by the byte loader
+    ck2 = tmp_path / "auto_byte.npz"
+    cfg2 = EngineConfig(
+        min_chunk=10, max_chunk=10, checkpoint_every=50,
+        checkpoint_path=str(ck2), auto_fast=False,
+    )
+    Engine(cfg2).run(
+        Params(turns=100, image_width=64, image_height=64), board
+    )
+    world, turn2, rule2 = load_checkpoint(ck2)
+    assert turn2 == 100 and world.shape == (64, 64)  # crossings at 50, 100
+
+
+def test_auto_checkpoint_stamps_active_plane_rule(tmp_path):
+    """An explicit plane with a non-config rule must be recorded in the
+    checkpoint — resuming a HIGHLIFE run as Conway would silently
+    diverge."""
+    import numpy as np
+
+    from gol_distributed_final_tpu.engine import Engine, load_packed_checkpoint
+    from gol_distributed_final_tpu.engine.engine import EngineConfig
+    from gol_distributed_final_tpu.models import HIGHLIFE
+    from gol_distributed_final_tpu.ops import bitpack
+    from gol_distributed_final_tpu.ops.plane import BitPlane
+    from gol_distributed_final_tpu.params import Params
+
+    rng = np.random.default_rng(6)
+    board = np.where(rng.random((64, 64)) < 0.3, 255, 0).astype(np.uint8)
+    ck = tmp_path / "hl.npz"
+    cfg = EngineConfig(
+        final_world=False, min_chunk=10, max_chunk=10,
+        checkpoint_every=20, checkpoint_path=str(ck),
+    )
+    Engine(cfg).run(
+        Params(turns=50, image_width=64, image_height=64),
+        None, plane=BitPlane(HIGHLIFE), initial_state=bitpack.pack(board, 0),
+    )
+    _, turn, rule, _ = load_packed_checkpoint(ck)
+    assert rule.rulestring == HIGHLIFE.rulestring and turn == 40
