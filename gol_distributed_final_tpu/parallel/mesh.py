@@ -9,7 +9,6 @@ dial loop, no per-turn TCP.
 
 from __future__ import annotations
 
-import math
 
 import jax
 from jax.sharding import Mesh

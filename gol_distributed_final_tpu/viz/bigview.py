@@ -14,7 +14,6 @@ surface the reference defines (sdl/window.go:10-104).
 from __future__ import annotations
 
 import threading
-import time
 
 import numpy as np
 
