@@ -47,6 +47,17 @@ halo DMA over up to 127 turns of in-VMEM evolution — the halo tiles are
 the in-kernel fori_loop defeats Mosaic's pipelining, so the single-turn
 form stands.
 
+At 65536^2 (512 grid steps/turn) effective bandwidth drops to ~350 GB/s
+against a measured 995 GB/s streaming ceiling: ~3-4 us of fixed
+per-grid-step orchestration dominates once steps number in the
+hundreds, and block size cannot grow past the ext budget. Ruled out
+empirically: strided body reads (word_axis=1's narrow [H, W/32] layout
+makes every read contiguous yet measured only ~5% faster — 3.41 vs
+3.58 ms/turn) and block-shape choice (a sweep moved <7%). Both
+packings are supported (``word_axis=``); the halo geometry is
+packing-agnostic because output word (i, j) reads words (i+-1, j+-1)
+either way (ops/bitpack.py).
+
 Reference equivalence: each turn computes exactly worker/worker.go:15-70's
 ``calculateNextState`` over the full board (via ops/bitpack.bit_step —
 bit-exact against the numpy oracle and the ``check/`` goldens at every
@@ -170,16 +181,27 @@ def _plan(
 
 
 def _tiled_kernel_rows(
-    top_ref, body_ref, bot_ref, out_ref, *, birth_mask, survive_mask, interpret
+    top_ref,
+    body_ref,
+    bot_ref,
+    out_ref,
+    *,
+    word_axis,
+    birth_mask,
+    survive_mask,
+    interpret,
 ):
     # 8-row edge strips only: (1 + 16/pb)x read instead of 3x, and the
-    # ext stays sublane-aligned
+    # ext stays sublane-aligned. bit_step's (i+-1, j+-1) word dependency
+    # holds for EITHER packing (ops/bitpack.py module docstring), so the
+    # same halo geometry serves word_axis=1 — the layout that keeps
+    # packed rows narrow (hence contiguous, fast DMA) on very wide boards
     ext = jnp.concatenate([top_ref[:], body_ref[:], bot_ref[:]], axis=0)
     from .pallas_stencil import pick_rot1
 
     out = bit_step(
         ext,
-        0,
+        word_axis,
         pick_rot1(interpret),
         birth_mask=birth_mask,
         survive_mask=survive_mask,
@@ -199,6 +221,7 @@ def _tiled_kernel_2d(
     br_ref,
     out_ref,
     *,
+    word_axis,
     birth_mask,
     survive_mask,
     interpret,
@@ -213,7 +236,7 @@ def _tiled_kernel_2d(
 
     out = bit_step(
         ext,
-        0,
+        word_axis,
         pick_rot1(interpret),
         birth_mask=birth_mask,
         survive_mask=survive_mask,
@@ -230,6 +253,7 @@ def _tiled_compiled(
     survive_mask: int = CONWAY_SURVIVE_MASK,
     block_rows: int | None = None,
     block_cols: int | None = None,
+    word_axis: int = 0,
 ):
     from jax.experimental import pallas as pl
 
@@ -255,7 +279,10 @@ def _tiled_compiled(
         return ((j + 1) % gc) * csub
 
     masks = dict(
-        birth_mask=birth_mask, survive_mask=survive_mask, interpret=interpret
+        word_axis=word_axis,
+        birth_mask=birth_mask,
+        survive_mask=survive_mask,
+        interpret=interpret,
     )
     if mode == "rows":
         one_turn = pl.pallas_call(
@@ -307,11 +334,14 @@ def tiled_bit_step_n_fn(
     rule=None,
     block_rows: int | None = None,
     block_cols: int | None = None,
+    word_axis: int = 0,
 ):
-    """A ``(packed_int32 [P, W], n) -> packed`` for word_axis=0 bitboards of
-    any size: n turns in one dispatch, one grid-tiled kernel launch per
-    turn, regime-picked blocks (see module docstring). Row-packed layout
-    only (the layout every large-board path uses — lanes stay W wide)."""
+    """A ``(packed_int32, n) -> packed`` bitboard evolution for any size:
+    n turns in one dispatch, one grid-tiled kernel launch per turn,
+    regime-picked blocks (see module docstring). Either packing: the
+    array is [H/32, W] for ``word_axis=0`` (lanes stay W wide — the
+    default) or [H, W/32] for ``word_axis=1`` (the layout that keeps
+    packed rows narrow and DMA contiguous on very wide boards)."""
     birth = rule.birth_mask if rule else CONWAY_BIRTH_MASK
     survive = rule.survive_mask if rule else CONWAY_SURVIVE_MASK
     if interpret is None:
@@ -321,7 +351,8 @@ def tiled_bit_step_n_fn(
 
     def step_n(packed, n):
         return _tiled_compiled(
-            int(n), packed.shape, interpret, birth, survive, block_rows, block_cols
+            int(n), packed.shape, interpret, birth, survive,
+            block_rows, block_cols, word_axis,
         )(packed)
 
     return step_n
