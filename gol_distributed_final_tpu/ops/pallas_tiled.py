@@ -47,16 +47,18 @@ halo DMA over up to 127 turns of in-VMEM evolution — the halo tiles are
 the in-kernel fori_loop defeats Mosaic's pipelining, so the single-turn
 form stands.
 
-At 65536^2 (512 grid steps/turn) effective bandwidth drops to ~350 GB/s
-against a measured 995 GB/s streaming ceiling: ~3-4 us of fixed
-per-grid-step orchestration dominates once steps number in the
-hundreds, and block size cannot grow past the ext budget. Ruled out
-empirically: strided body reads (word_axis=1's narrow [H, W/32] layout
-makes every read contiguous yet measured only ~5% faster — 3.41 vs
-3.58 ms/turn) and block-shape choice (a sweep moved <7%). Both
-packings are supported (``word_axis=``); the halo geometry is
-packing-agnostic because output word (i, j) reads words (i+-1, j+-1)
-either way (ops/bitpack.py).
+At 65536^2 effective bandwidth is ~350 GB/s against a 995 GB/s XLA
+streaming ceiling — and a TRIVIAL pallas copy kernel (out = in + 1)
+over the same grid/blocks measures the same ~315 GB/s: the life kernel
+sits AT the pallas pipeline's own HBM-DMA ceiling on this
+chip/toolchain, so the gap is Mosaic's grid pipeline, not this kernel.
+Also ruled out empirically: strided body reads (word_axis=1's narrow
+[H, W/32] layout makes every read contiguous yet measured only ~5%
+faster — 3.41 vs 3.58 ms/turn) and block-shape choice (a sweep moved
+<7%). Net: the kernel is compute-roofline-bound at <= 16384^2 and
+pallas-pipeline-DMA-bound above. Both packings are supported
+(``word_axis=``); the halo geometry is packing-agnostic because output
+word (i, j) reads words (i+-1, j+-1) either way (ops/bitpack.py).
 
 Reference equivalence: each turn computes exactly worker/worker.go:15-70's
 ``calculateNextState`` over the full board (via ops/bitpack.bit_step —
