@@ -204,6 +204,35 @@ def _check_byte_free_engine(engine) -> None:
         )
 
 
+class _LazyAliveCells:
+    """A sequence-shaped ``FinalTurnComplete.alive`` payload that never
+    materialises the O(alive) Python Cell list unless actually iterated:
+    ``len()`` is a device-side popcount. A dense 65536^2 board would
+    otherwise build billions of Cell objects on the one surface that
+    promises GiB-scale state never exists on host (ADVICE.md round 3).
+    The byte-scale parity surface (engine/controller.py) keeps the eager
+    list the reference ships (gol/event.go:65-68)."""
+
+    def __init__(self, plane, state):
+        self._plane = plane
+        self._state = state
+
+    def __len__(self) -> int:
+        return int(self._plane.alive_count(self._state))
+
+    def __iter__(self):
+        return iter(self._plane.alive_cells(self._state))
+
+    def __eq__(self, other):
+        try:
+            other_list = list(other)
+        except TypeError:
+            # a List[Cell] payload compared False against non-iterables;
+            # this stand-in must not raise where the list did not
+            return NotImplemented
+        return list(self) == other_list
+
+
 class _PackedBroker:
     """The slice of the stubs verb surface the ticker needs, served by an
     engine holding a packed state. ``retrieve`` is always count-only —
@@ -306,8 +335,12 @@ def big_session(
             )
         finally:
             ticker.stop()
-        events.put(FinalTurnComplete(result.turns_completed, result.alive))
         final = engine.final_state()
+        events.put(
+            FinalTurnComplete(
+                result.turns_completed, _LazyAliveCells(plane, final)
+            )
+        )
         if final is not None:
             stream_packed_to_pgm(out_file, final, word_axis, row_block)
         events.put(
@@ -367,7 +400,9 @@ def main(argv=None) -> int:
         finally:
             consumer.join()
             restore_tty()
-        print(f"alive {len(result.alive)}")
+        # device-side popcount, not len(list-of-Cells): the count must not
+        # be the one thing that materialises O(alive) host objects
+        print(f"alive {result.alive_count}")
         return 0
     alive = run_big_board(
         args.size, args.turns, args.out,

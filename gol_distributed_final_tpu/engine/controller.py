@@ -107,6 +107,7 @@ class _Ticker:
         self.tick_seconds = tick_seconds
         self.done = threading.Event()
         self.paused = False
+        self._last_turn = 0  # last turn seen by any successful retrieve
         self._thread = threading.Thread(target=self._loop, daemon=True)
 
     def start(self):
@@ -121,6 +122,26 @@ class _Ticker:
         write_board(snap.world, self.params.output_filename, self.out_dir)
         return snap
 
+    def _try_snapshot_turn(self) -> int:
+        """Snapshot-to-PGM for the q/k paths, degrading to a count-only
+        turn read, then to the last tick's turn: quitting must never be
+        blocked by a broken snapshot OR a dead broker — if this raised,
+        done.set()/quit() would be skipped and the session could never be
+        quit from the keyboard."""
+        try:
+            turn = self._snapshot_to_pgm().turns_completed
+            self._last_turn = turn
+            return turn
+        except Exception as exc:
+            print(f"final snapshot failed: {exc}")
+        try:
+            turn = self.broker.retrieve(include_world=False).turns_completed
+            self._last_turn = turn
+            return turn
+        except Exception as exc:
+            print(f"turn read failed: {exc}")
+            return self._last_turn
+
     def _loop(self):
         next_tick = time.monotonic() + self.tick_seconds
         while not self.done.is_set():
@@ -131,7 +152,13 @@ class _Ticker:
                 except queue.Empty:
                     key = None
             if key is not None:
-                self._handle_key(key)
+                try:
+                    self._handle_key(key)
+                except Exception as exc:
+                    # the control thread must survive a failed key action
+                    # (e.g. a snapshot ValueError from an exotic broker):
+                    # dying here silently kills the 2 s tick AND q/k/p
+                    print(f"key '{key}' failed: {exc}")
                 continue
             if time.monotonic() >= next_tick:
                 # re-anchor rather than increment: after a long keypress
@@ -140,7 +167,14 @@ class _Ticker:
                 next_tick = time.monotonic() + self.tick_seconds
                 # count-only snapshot: a device-side reduction, no full-board
                 # device->host copy on the tick path
-                snap = self.broker.retrieve(include_world=False)
+                try:
+                    snap = self.broker.retrieve(include_world=False)
+                except Exception as exc:
+                    # a raising tick must not kill the control thread —
+                    # keypresses (including 'q') still need servicing
+                    print(f"tick retrieve failed: {exc}")
+                    continue
+                self._last_turn = snap.turns_completed
                 if not self.paused and not self.done.is_set():
                     self.events.put(
                         AliveCellsCount(snap.turns_completed, snap.alive_count)
@@ -151,31 +185,35 @@ class _Ticker:
     def _handle_key(self, key):
         # gol/distributor.go:61-122
         if key == "q":
-            snap = self._snapshot_to_pgm()
-            self.events.put(StateChange(snap.turns_completed, Quitting))
+            turn = self._try_snapshot_turn()
+            self.events.put(StateChange(turn, Quitting))
             self.done.set()
             self.broker.quit()
         elif key == "s":
             print(self.params.output_filename)
             self._snapshot_to_pgm()
         elif key == "k":
-            snap = self._snapshot_to_pgm()
-            self.events.put(StateChange(snap.turns_completed, Quitting))
+            turn = self._try_snapshot_turn()
+            self.events.put(StateChange(turn, Quitting))
             self.done.set()
             self.broker.super_quit()
         elif key == "p":
             snap = self.broker.retrieve(include_world=False)
+            self._last_turn = snap.turns_completed
+            # pause() BEFORE the StateChange: if the broker call raises,
+            # no Paused/Executing event has been emitted yet — otherwise
+            # the printed state and the engine state silently disagree
             if not self.paused:
-                self.events.put(StateChange(snap.turns_completed, State.PAUSED))
                 self.broker.pause()
+                self.events.put(StateChange(snap.turns_completed, State.PAUSED))
                 self.paused = True
             else:
+                self.broker.pause()
                 # the reference reports one turn fewer on resume
                 # (gol/distributor.go:118) — preserved for parity
                 self.events.put(
                     StateChange(snap.turns_completed - 1, State.EXECUTING)
                 )
-                self.broker.pause()
                 self.paused = False
 
 

@@ -65,7 +65,14 @@ class RunResult:
     run, where the byte raster must never exist: cells come from the
     plane's sparse extraction)."""
 
-    __slots__ = ("turns_completed", "world", "_alive", "_state", "_plane")
+    __slots__ = (
+        "turns_completed",
+        "world",
+        "checkpoint_error",
+        "_alive",
+        "_state",
+        "_plane",
+    )
 
     def __init__(
         self,
@@ -74,9 +81,14 @@ class RunResult:
         alive: Optional[List[Cell]] = None,
         state=None,
         plane=None,
+        checkpoint_error: Optional[OSError] = None,
     ):
         self.turns_completed = turns_completed
         self.world = world
+        # non-fatal: the last periodic-checkpoint IO failure, if any — the
+        # run itself completed (a disk-full must not abort the multi-hour
+        # run checkpointing exists to protect; ADVICE.md round 3)
+        self.checkpoint_error = checkpoint_error
         self._alive = alive
         self._state = state
         self._plane = plane
@@ -93,6 +105,20 @@ class RunResult:
                 # (ops/plane.py:12-17) fall back through decode
                 self._alive = alive_cells(self._plane.decode(self._state))
         return self._alive
+
+    @property
+    def alive_count(self) -> int:
+        """The live-cell total WITHOUT materialising the O(alive) Cell
+        list — a device-side popcount for plane-state results. What the
+        big-board CLI prints (a dense 65536^2 board would otherwise build
+        billions of Cell objects; ADVICE.md round 3)."""
+        if self._alive is not None:
+            return len(self._alive)
+        if self.world is not None:
+            return int(np.count_nonzero(self.world))
+        if hasattr(self._plane, "alive_count"):
+            return int(self._plane.alive_count(self._state))
+        return len(self.alive)
 
 
 @dataclasses.dataclass
@@ -276,6 +302,7 @@ class Engine:
             # keeps retrieve latency <= depth x target_dispatch_seconds.
             inflight: deque = deque()
             growth_done = False  # doubling ended (max_chunk OR slow dispatch)
+            ckpt_error: OSError | None = None
             while True:
                 with self._lock:
                     while self._paused and not self._quit:
@@ -333,16 +360,35 @@ class Engine:
 
                 every = self.config.checkpoint_every
                 if every and turn_now // every > (turn_now - n) // every:
-                    self._write_checkpoint(new_state, turn_now)
+                    try:
+                        self._write_checkpoint(new_state, turn_now)
+                    except OSError as exc:
+                        # a full disk must not abort the multi-hour run
+                        # this checkpoint exists to protect; the failure is
+                        # surfaced on the RunResult (ADVICE.md round 3)
+                        ckpt_error = exc
+                        print(
+                            f"checkpoint at turn {turn_now} failed: {exc}"
+                        )
 
             with self._lock:
                 turns_done = self._turn
                 if self.config.final_world:
                     self._sync_host()
-                    return RunResult(turns_done, self._world_host)
+                    return RunResult(
+                        turns_done,
+                        self._world_host,
+                        checkpoint_error=ckpt_error,
+                    )
                 state_f, plane_f = self._state, self._plane
             # lazy: .alive extracts from the plane state only if read
-            return RunResult(turns_done, None, state=state_f, plane=plane_f)
+            return RunResult(
+                turns_done,
+                None,
+                state=state_f,
+                plane=plane_f,
+                checkpoint_error=ckpt_error,
+            )
         finally:
             with self._lock:
                 self._running = False
@@ -394,7 +440,15 @@ class Engine:
             self._control.notify_all()
             print("State paused" if state else "State unpaused")
             if state:
-                while self._running and not self._parked and not self._quit:
+                # re-check _paused each wake: a concurrent unpause (another
+                # controller toggling) means the loop will never park — the
+                # wait must end with the toggle, not strand until run-end
+                while (
+                    self._paused
+                    and self._running
+                    and not self._parked
+                    and not self._quit
+                ):
                     self._control.wait(timeout=0.1)
             return state
 
@@ -443,6 +497,17 @@ class Engine:
         instead of the whole board. The reference re-ships the full world on
         every Retrieve (broker/broker.go:262-270); the TPU-first control
         plane does not."""
+        if include_world and not self.config.final_world:
+            # mirror of bigboard._check_byte_free_engine, enforced at the
+            # Engine surface itself: a final_world=False run promises the
+            # byte raster never exists, and decoding it here would
+            # materialise 4 GiB at 65536^2 (ADVICE.md round 3)
+            raise ValueError(
+                "retrieve(include_world=True) on a final_world=False "
+                "engine would decode the full byte raster this "
+                "configuration promises never exists; use "
+                "include_world=False (count-only) or state_snapshot()"
+            )
         with self._lock:
             turn = self._turn
             if include_world:

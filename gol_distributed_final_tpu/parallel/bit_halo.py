@@ -169,28 +169,10 @@ def sharded_bit_step_n_fn(
     sharding = packed_sharding(mesh)
 
     @functools.lru_cache(maxsize=None)
-    def _compiled(n: int):
+    def _compiled(n: int, use_pallas: bool):
+        step = local_pallas if use_pallas else local
+
         def local_n(block):
-            # trace-time routing on the static LOCAL block shape
-            if pallas_local is None:
-                use_pallas = (
-                    _pallas_local_ok(block.shape, word_axis) and not interpret
-                )
-            else:
-                use_pallas = pallas_local
-                if use_pallas and word_axis != 0:
-                    # the pallas kernels hardcode row packing; silently
-                    # running them on a column-packed board would return a
-                    # wrong evolution
-                    raise ValueError(
-                        "pallas_local=True requires word_axis=0"
-                    )
-                if use_pallas and not _pallas_local_aligned(block.shape):
-                    raise ValueError(
-                        f"pallas_local=True requires a sublane/lane-aligned "
-                        f"local block; got {tuple(block.shape)}"
-                    )
-            step = local_pallas if use_pallas else local
             return lax.fori_loop(0, n, lambda _, b: step(b), block)
 
         sharded = jax.shard_map(
@@ -199,13 +181,35 @@ def sharded_bit_step_n_fn(
             in_specs=P(ROWS, COLS),
             out_specs=P(ROWS, COLS),
             # pallas_call emits vma-less ShapeDtypeStructs, which the
-            # varying-mesh-axes checker rejects inside shard_map
-            check_vma=False,
+            # varying-mesh-axes checker rejects inside shard_map — so the
+            # checker is relaxed ONLY when the pallas kernel is routed;
+            # the plain XLA local step keeps it on (ADVICE.md round 3)
+            check_vma=not use_pallas,
         )
         return jax.jit(sharded, in_shardings=sharding, out_shardings=sharding)
 
     def step_n(packed, n):
-        return _compiled(int(n))(packed)
+        # routing on the static LOCAL block shape, decided before the
+        # shard_map is built so check_vma can follow the decision
+        block_shape = (
+            packed.shape[0] // mesh_shape[0],
+            packed.shape[1] // mesh_shape[1],
+        )
+        if pallas_local is None:
+            use_pallas = _pallas_local_ok(block_shape, word_axis) and not interpret
+        else:
+            use_pallas = bool(pallas_local)
+            if use_pallas and word_axis != 0:
+                # the pallas kernels hardcode row packing; silently
+                # running them on a column-packed board would return a
+                # wrong evolution
+                raise ValueError("pallas_local=True requires word_axis=0")
+            if use_pallas and not _pallas_local_aligned(block_shape):
+                raise ValueError(
+                    f"pallas_local=True requires a sublane/lane-aligned "
+                    f"local block; got {tuple(block_shape)}"
+                )
+        return _compiled(int(n), use_pallas)(packed)
 
     return step_n
 

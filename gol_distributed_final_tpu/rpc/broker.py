@@ -117,6 +117,7 @@ class WorkersBackend:
         self._world: np.ndarray | None = None
         self._turn = 0
         self._paused = False
+        self._parked = False  # turn loop is actually waiting in the gate
         self._quit = False
         self._running = False
 
@@ -130,6 +131,7 @@ class WorkersBackend:
                 raise RpcError("a run is already in progress")
             self._world, self._turn = world, req.initial_turn
             self._paused = False
+            self._parked = False
             self._running = True
 
         try:
@@ -184,7 +186,10 @@ class WorkersBackend:
             for _ in range(req.turns - req.initial_turn):
                 with self._lock:
                     while self._paused and not self._quit:
+                        self._parked = True
+                        self._control.notify_all()
                         self._control.wait()
+                    self._parked = False
                     if self._quit:
                         return
                     world = self._world
@@ -222,10 +227,25 @@ class WorkersBackend:
                     self._turn += 1
 
     def pause(self):
+        """Toggle pause. On pause, blocks until the turn loop has actually
+        parked (the in-flight turn has committed) — the same guarantee as
+        ``Engine.pause`` (engine/engine.py), so the two backends give one
+        semantics behind the ``Operations.Pause`` verb: a retrieve after
+        pause() returns can never observe another turn (VERDICT round 3)."""
         with self._lock:
             self._paused = not self._paused
             self._control.notify_all()
             print("State paused" if self._paused else "State unpaused")
+            if self._paused:
+                # re-check _paused each wake: a concurrent unpause from
+                # another handler thread means the loop never parks
+                while (
+                    self._paused
+                    and self._running
+                    and not self._parked
+                    and not self._quit
+                ):
+                    self._control.wait(timeout=0.1)
 
     def quit(self):
         with self._lock:

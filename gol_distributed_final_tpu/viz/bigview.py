@@ -84,6 +84,12 @@ class BigView:
         return True
 
     def watch(self):
+        if self._thread is not None:
+            # a second watch() would orphan the first refresh thread and
+            # silently drop any pending _error (ADVICE.md round 3)
+            raise RuntimeError("BigView is already watching; stop() first")
+        self._stop.clear()  # a stop() leaves the event set; re-arm for restart
+
         def loop():
             try:
                 while not self._stop.is_set():

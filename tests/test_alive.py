@@ -71,10 +71,15 @@ def test_alive_counts_match_golden_csv(tmp_path):
     alive_events = [e for e in collected if isinstance(e, AliveCellsCount)]
     assert len(alive_events) >= 5, "liveness: ticker must report"
     for ev in alive_events:
+        # beyond the 10k-turn CSV the 64^2 board is in its steady state of
+        # 101 (check/alive/64x64.csv:10001) — the reference's own test
+        # asserts the steady state past the CSV the same way
+        # (count_test.go:45-51); ticks land there when compile caches are
+        # warm and the engine races past 10k before five ticks elapse
         expected = (
             initial_alive
             if ev.completed_turns == 0
-            else counts[ev.completed_turns]
+            else counts.get(ev.completed_turns, 101)
         )
         assert ev.cells_count == expected, (
             f"turn {ev.completed_turns}: got {ev.cells_count}, want {expected}"

@@ -295,3 +295,140 @@ def test_pipeline_engages_when_growth_stops_on_slow_dispatch():
     # remaining 39 single-turn chunks flow through the depth-3 window
     assert calls["chunks"][0] == 1 and len(calls["chunks"]) == 40
     assert calls["sync"] <= len(calls["chunks"]) - 2, calls["sync"]
+
+
+def test_retrieve_world_raises_on_byte_free_engine():
+    """A final_world=False engine must refuse retrieve(include_world=True):
+    decoding the full byte raster is exactly what that configuration
+    promises never happens (the broker wrappers already enforce this; the
+    Engine surface itself must too)."""
+    import pytest
+
+    from gol_distributed_final_tpu.ops import bitpack
+    from gol_distributed_final_tpu.ops.plane import BitPlane
+
+    engine = Engine(EngineConfig(final_world=False))
+    engine.run(
+        Params(turns=2, image_width=64, image_height=64),
+        None,
+        plane=BitPlane(),
+        initial_state=bitpack.pack(small_board(11, 64), 0),
+    )
+    with pytest.raises(ValueError, match="include_world"):
+        engine.retrieve()
+    # the count-only path stays open
+    snap = engine.retrieve(include_world=False)
+    assert snap.world is None and snap.turns_completed == 2
+
+
+def test_checkpoint_io_error_does_not_abort_run(tmp_path):
+    """A failing checkpoint write (disk full, bad path) must not kill the
+    multi-hour run it exists to protect: the run completes and the failure
+    is surfaced on the RunResult."""
+    blocker = tmp_path / "not_a_dir"
+    blocker.write_text("a file where the checkpoint wants a directory")
+    cfg = EngineConfig(
+        min_chunk=10,
+        max_chunk=10,
+        checkpoint_every=30,
+        checkpoint_path=str(blocker / "ck.npz"),  # mkdir will fail
+    )
+    res = Engine(cfg).run(
+        Params(turns=100, image_width=64, image_height=64), small_board(12, 64)
+    )
+    assert res.turns_completed == 100
+    assert isinstance(res.checkpoint_error, OSError)
+
+
+def test_ticker_survives_snapshot_failure_and_still_quits():
+    """A failing snapshot ('s' on a broker that cannot ship a world) must
+    not kill the control thread, and 'q' must still quit even when its
+    final snapshot fails — otherwise the engine runs forever with no way
+    to stop it."""
+    from gol_distributed_final_tpu.engine.controller import _Ticker
+    from gol_distributed_final_tpu.engine.engine import Snapshot
+
+    class ByteFreeBroker:
+        def __init__(self):
+            self.quit_called = threading.Event()
+
+        def retrieve(self, include_world=True):
+            if include_world:
+                raise ValueError("no byte raster on this surface")
+            return Snapshot(None, 5, 7)
+
+        def quit(self):
+            self.quit_called.set()
+
+        def pause(self):
+            pass
+
+        def super_quit(self):
+            pass
+
+    broker = ByteFreeBroker()
+    events, keys = queue.Queue(), queue.Queue()
+    ticker = _Ticker(
+        Params(turns=10, image_width=16, image_height=16),
+        events, keys, broker, "out", 3600.0,
+    )
+    ticker.start()
+    try:
+        keys.put("s")  # snapshot raises; thread must survive
+        time.sleep(0.2)
+        assert ticker._thread.is_alive(), "ticker died on a failed snapshot"
+        keys.put("q")  # final snapshot raises too; quit must still land
+        assert broker.quit_called.wait(timeout=5), "'q' did not reach quit()"
+        quits = [e for e in iter_drain(events) if isinstance(e, StateChange)]
+        assert quits and quits[-1].new_state == State.QUITTING
+        assert quits[-1].completed_turns == 5  # count-only fallback turn
+    finally:
+        ticker.stop()
+
+
+def iter_drain(q):
+    out = []
+    while True:
+        try:
+            out.append(q.get_nowait())
+        except queue.Empty:
+            return out
+
+
+def test_ticker_quits_even_when_broker_is_dead():
+    """'q' on a fully dead broker (every retrieve raises) must still set
+    done and deliver quit() — the turn falls back to the last one a
+    successful tick saw."""
+    from gol_distributed_final_tpu.engine.controller import _Ticker
+
+    class DeadBroker:
+        def __init__(self):
+            self.quit_called = threading.Event()
+
+        def retrieve(self, include_world=True):
+            raise OSError("connection lost")
+
+        def quit(self):
+            self.quit_called.set()
+
+        def pause(self):
+            pass
+
+        def super_quit(self):
+            pass
+
+    broker = DeadBroker()
+    events, keys = queue.Queue(), queue.Queue()
+    ticker = _Ticker(
+        Params(turns=10, image_width=16, image_height=16),
+        events, keys, broker, "out", 0.05,  # fast ticks: they fail too
+    )
+    ticker.start()
+    try:
+        time.sleep(0.2)  # several failing ticks; thread must survive them
+        assert ticker._thread.is_alive(), "ticker died on failing ticks"
+        keys.put("q")
+        assert broker.quit_called.wait(timeout=5), "'q' did not reach quit()"
+        assert ticker.done.is_set()
+    finally:
+        ticker.stop()

@@ -111,3 +111,28 @@ def test_bigview_tracks_engine_session():
     oracle = oracle_window(SIZE, TURNS, WIN)
     np.testing.assert_array_equal((view.window._pixels != 0), oracle != 0)
     assert view.last_turn == TURNS
+
+
+def test_bigview_double_watch_raises():
+    """A second watch() while one is live would orphan the first refresh
+    thread and drop its pending _error (ADVICE.md round 3)."""
+    from gol_distributed_final_tpu.engine import Engine
+    from gol_distributed_final_tpu.viz.bigview import BigView
+
+    view = BigView(Engine(), 0, 0, 8, 8, window=Window(8, 8), interval=0.05)
+    view.watch()
+    try:
+        with pytest.raises(RuntimeError, match="already watching"):
+            view.watch()
+    finally:
+        view.stop()
+    # after stop(), watching again must actually loop (the _stop event is
+    # re-armed, not left set from the previous stop — a set event would
+    # make the restarted thread exit before its first refresh)
+    import time
+
+    view.watch()
+    time.sleep(0.2)
+    assert view._thread.is_alive(), "restarted watch thread exited immediately"
+    view.stop()
+    assert view._thread is None
