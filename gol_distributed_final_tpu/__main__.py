@@ -83,11 +83,10 @@ def main(argv=None) -> int:
     parser.add_argument(
         "-resume", default=None, metavar="CKPT",
         help="continue from an engine/checkpoint.py .npz instead of "
-             "images/<W>x<H>.pgm at turn 0 (in-process engine only)",
+             "images/<W>x<H>.pgm at turn 0; with -server the checkpoint's "
+             "board, turn, and rule are shipped to the remote broker",
     )
     args = parser.parse_args(argv)
-    if args.resume and args.server:
-        parser.error("-resume needs the in-process engine (no -server)")
 
     from . import Params, run
 

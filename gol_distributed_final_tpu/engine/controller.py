@@ -69,8 +69,24 @@ class InProcessBroker:
         self.engine = engine or Engine()
 
     def run(
-        self, params, world, *, emit=None, emit_flips=False, initial_turn=0
+        self,
+        params,
+        world,
+        *,
+        emit=None,
+        emit_flips=False,
+        initial_turn=0,
+        rule=None,
     ) -> RunResult:
+        if rule is not None and rule.rulestring != self.engine.config.rule.rulestring:
+            # a resumed checkpoint's rule must match the engine it resumes
+            # on — for the in-process path the session builds the engine
+            # from the checkpoint, so a mismatch means a caller-supplied
+            # engine configured differently
+            raise ValueError(
+                f"checkpoint rule {rule.rulestring} does not match the "
+                f"engine's {self.engine.config.rule.rulestring}"
+            )
         return self.engine.run(
             params,
             world,
@@ -283,12 +299,16 @@ def run(
         world = ckpt_world if resume_from is not None else read_board(params, images_dir)
         ticker = _Ticker(params, events, keypresses, broker, out_dir, tick_seconds)
         ticker.start()
+        # the checkpoint's rule rides along only on a resume: brokers are
+        # duck-typed and pre-resume fakes/backends need not know the kwarg
+        extra = {} if ckpt_rule is None else {"rule": ckpt_rule}
         result = broker.run(
             params,
             world,
             emit=events.put if emit_flips else None,
             emit_flips=emit_flips,
             initial_turn=initial_turn,
+            **extra,
         )
         # join the ticker BEFORE the closing sequence so no stray
         # AliveCellsCount can interleave after StateChange{Quitting}

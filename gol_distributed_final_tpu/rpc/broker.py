@@ -43,12 +43,12 @@ class TpuBackend:
         self.engine = Engine()
         self._planes: dict = {}
 
-    def _plane_for(self, height: int, width: int):
+    def _plane_for(self, height: int, width: int, rule):
         """A mesh data plane if the local devices divide the board — the
         bit-packed halo plane when a packed layout divides too (the fast
         kernel on every 'worker', parallel/bit_halo.py), else the byte halo
         plane; None for a single device (the engine auto-picks)."""
-        key = (height, width)
+        key = (height, width, rule.rulestring)
         if key not in self._planes:
             plane = None
             if self._use_mesh:
@@ -61,13 +61,20 @@ class TpuBackend:
                 if len(jax.devices()) > 1:
                     try:
                         mesh = make_mesh(height=height, width=width)
-                        plane = make_bit_plane(mesh, (height, width))
+                        plane = make_bit_plane(mesh, (height, width), rule)
                         if plane is None:
-                            plane = BytePlane(
-                                self.engine.config.rule, make_engine_step(mesh)
-                            )
+                            plane = BytePlane(rule, make_engine_step(mesh, rule))
                     except ValueError:
                         pass  # indivisible board: single-device engine
+            if plane is None and rule.rulestring != self.engine.config.rule.rulestring:
+                # single-device non-default rule (a resumed checkpoint):
+                # the engine would auto-pick with ITS config rule, so the
+                # right plane must be handed over explicitly — same
+                # policy as the engine's own auto-pick (ops/auto.py)
+                from ..ops.auto import auto_plane
+                from ..ops.plane import BytePlane
+
+                plane = auto_plane(rule, (height, width)) or BytePlane(rule)
             self._planes[key] = plane
         return self._planes[key]
 
@@ -80,7 +87,15 @@ class TpuBackend:
             image_width=req.image_width,
             image_height=req.image_height,
         )
-        plane = self._plane_for(req.image_height, req.image_width)
+        rule = self.engine.config.rule
+        if req.rulestring:
+            # a resumed checkpoint's rule travels on the wire; canonicalise
+            # (case/whitespace) and honor it by picking the plane
+            # explicitly instead of silently evolving under the default
+            from ..models import LifeRule
+
+            rule = LifeRule.from_rulestring(req.rulestring)
+        plane = self._plane_for(req.image_height, req.image_width, rule)
         return self.engine.run(
             params, req.world, plane=plane, initial_turn=req.initial_turn
         )
@@ -124,6 +139,22 @@ class WorkersBackend:
     def run(self, req: Request) -> RunResult:
         if not self.clients:
             raise RpcError("no workers connected")
+        if req.rulestring:
+            # the reference-shaped workers hard-code Conway
+            # (worker/worker.go:41-46, mirrored in rpc/worker._strip_step);
+            # silently evolving a resumed non-Conway checkpoint would
+            # diverge. Canonicalise before comparing so e.g. "b3/s23"
+            # is accepted as the Conway it is.
+            from ..models import CONWAY, LifeRule
+
+            try:
+                canonical = LifeRule.from_rulestring(req.rulestring).rulestring
+            except ValueError as e:
+                raise RpcError(str(e)) from e
+            if canonical != CONWAY.rulestring:
+                raise RpcError(
+                    f"workers backend computes Conway only, not {canonical}"
+                )
         world = np.array(req.world, np.uint8, copy=True)
         h = world.shape[0]
         with self._lock:
@@ -284,6 +315,20 @@ class BrokerService:
         self.quit_event = threading.Event()
 
     def run(self, req: Request) -> Response:
+        # server-side resume validation: the client's checkpoint loader
+        # validates too, but this surface is reachable by any client
+        if not 0 <= req.initial_turn <= req.turns:
+            raise ValueError(
+                f"initial_turn {req.initial_turn} outside [0, {req.turns}]"
+            )
+        if req.world is not None and req.world.shape != (
+            req.image_height,
+            req.image_width,
+        ):
+            raise ValueError(
+                f"world shape {req.world.shape} does not match params "
+                f"{req.image_width}x{req.image_height}"
+            )
         result = self.backend.run(req)
         if result.world is None:
             raise ValueError(

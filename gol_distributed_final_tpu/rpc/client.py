@@ -103,7 +103,16 @@ class RemoteBroker:
     def __init__(self, address: str = "127.0.0.1:8040", timeout: float | None = 10.0):
         self.client = RpcClient(address, timeout=timeout)
 
-    def run(self, params, world, *, emit=None, emit_flips=False, initial_turn=0):
+    def run(
+        self,
+        params,
+        world,
+        *,
+        emit=None,
+        emit_flips=False,
+        initial_turn=0,
+        rule=None,
+    ):
         # emit/emit_flips are single-host features; the distributed reference
         # never emits CellFlipped/TurnComplete either (SURVEY.md §4 TestSdl note)
         req = Request(
@@ -113,6 +122,7 @@ class RemoteBroker:
             image_width=params.image_width,
             threads=params.threads,
             initial_turn=initial_turn,
+            rulestring=rule.rulestring if rule is not None else "",
         )
         res = self.client.call(Methods.BROKER_RUN, req)
         from ..engine.engine import RunResult

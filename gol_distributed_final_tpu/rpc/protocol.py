@@ -50,6 +50,10 @@ class Request:
     worker: int = 0
     include_world: bool = True  # extension: count-only Retrieve
     initial_turn: int = 0  # extension: resume-from-checkpoint support
+    # extension: the checkpoint's rule on a resumed Run ("" = the server's
+    # default). Without it a remote resume of e.g. a HIGHLIFE checkpoint
+    # would silently continue under Conway.
+    rulestring: str = ""
 
 
 @dataclasses.dataclass

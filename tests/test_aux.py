@@ -197,13 +197,17 @@ def test_cli_resume_session(tmp_path, monkeypatch):
     golden = (REPO_ROOT / "check" / "images" / "64x64x100.pgm").read_bytes()
     assert raw[raw.index(b"255\n") + 4:] == golden[golden.index(b"255\n") + 4:]
 
-    # -resume is in-process only: combining with -server must error out
+    # -resume with -server is supported (the checkpoint ships over the
+    # wire — tests/test_rpc.py::test_remote_resume_from_checkpoint); an
+    # unreachable broker must fail with a connection error, not an
+    # argument-parsing rejection
     r2 = subprocess.run(
         [sys.executable, "-m", "gol_distributed_final_tpu",
          "-resume", str(ck), "-server", "127.0.0.1:1", "-noVis"],
         capture_output=True, text=True, timeout=60, env=env, cwd=tmp_path,
     )
-    assert r2.returncode != 0 and "in-process" in r2.stderr
+    assert r2.returncode != 0 and "in-process" not in r2.stderr
+    assert "ConnectionRefused" in r2.stderr or "refused" in r2.stderr
 
 
 def test_resume_validates_shape_and_turns(tmp_path):
