@@ -78,6 +78,141 @@ def save_packed_checkpoint(
     )
 
 
+def checkpoint_shard_path(path, rank: int, num_processes: int) -> pathlib.Path:
+    """Where rank ``rank``'s shard of a multi-host checkpoint lives:
+    ``<stem>.rank<k>of<n>.npz`` next to the configured path. Works on a
+    shared filesystem (all shards side by side) and on per-host disks
+    (each rank only ever touches its own name)."""
+    p = npz_path(path)
+    return p.with_name(f"{p.stem}.rank{rank}of{num_processes}.npz")
+
+
+def local_packed_rows(state) -> tuple[int, np.ndarray]:
+    """This process's contiguous block of packed word rows, assembled from
+    the global array's addressable shards -> (first_global_row, rows).
+
+    Requires the process to own whole contiguous rows of the packed array
+    (the canonical process-major ('rows', 'cols') placement —
+    parallel/multihost.host_row_range makes the same demand of byte
+    boards); raises if the addressable shards leave gaps."""
+    shards = list(state.addressable_shards)
+    if not shards:
+        raise ValueError("state has no addressable shards on this process")
+    n_rows, n_cols = state.shape
+    row0 = min(s.index[0].start or 0 for s in shards)
+    row1 = max(
+        n_rows if s.index[0].stop is None else s.index[0].stop for s in shards
+    )
+    out = np.zeros((row1 - row0, n_cols), np.int32)
+    filled = np.zeros((row1 - row0, n_cols), bool)
+    for s in shards:
+        r0 = s.index[0].start or 0
+        c0 = s.index[1].start or 0
+        data = np.asarray(s.data)
+        out[r0 - row0 : r0 - row0 + data.shape[0], c0 : c0 + data.shape[1]] = data
+        filled[r0 - row0 : r0 - row0 + data.shape[0], c0 : c0 + data.shape[1]] = True
+    if not filled.all():
+        raise ValueError(
+            "this process's shards do not cover a contiguous whole-row "
+            "block; use a process-major ('rows', 'cols') mesh placement"
+        )
+    return row0, out
+
+
+def save_packed_checkpoint_sharded(
+    path, state, turn: int, rule: LifeRule = CONWAY, word_axis: int = 0
+) -> pathlib.Path:
+    """One checkpoint shard per process for a multi-host packed board:
+    each rank writes ONLY its own word rows (the 65536^2 board never
+    materialises anywhere), to a temp name atomically renamed so a crash
+    mid-write leaves the previous shard intact. Every shard stamps the
+    turn / rule / global shape / process count, so the loader can refuse
+    mismatched reassembly."""
+    import jax
+
+    rank, nprocs = jax.process_index(), jax.process_count()
+    row0, rows = local_packed_rows(state)
+    final = checkpoint_shard_path(path, rank, nprocs)
+    tmp = final.with_name(final.name + ".tmp")
+    written = _save_npz(
+        tmp,
+        packed=rows,
+        row0=np.int64(row0),
+        global_rows=np.int64(state.shape[0]),
+        global_cols=np.int64(state.shape[1]),
+        num_processes=np.int64(nprocs),
+        process_index=np.int64(rank),
+        word_axis=np.int64(word_axis),
+        turn=np.int64(turn),
+        rulestring=np.str_(rule.rulestring),
+    )
+    written.replace(final)
+    return final
+
+
+def load_packed_checkpoint_sharded(path, sharding):
+    """Each rank loads ITS shard of a multi-host packed checkpoint and
+    re-places it onto the mesh -> (global array, turn, rule, word_axis).
+
+    ``sharding`` is the target NamedSharding (parallel/bit_halo
+    ``packed_sharding(mesh)``). Validates that the shard was written by a
+    job of the same process count, that this rank's stored row offset
+    matches where the sharding will place its local block, and (via the
+    global shape) that the board geometry is unchanged. COLLECTIVE in a
+    multi-process job: ranks allgather their shard turns and refuse a
+    mixed set — resuming ranks from different turns would desynchronise
+    every later collective (a crash between two ranks' shard renames can
+    leave exactly that on disk)."""
+    import jax
+
+    rank, nprocs = jax.process_index(), jax.process_count()
+    p = checkpoint_shard_path(path, rank, nprocs)
+    if nprocs == 1 and not p.exists() and npz_path(path).exists():
+        # single-process runs write the plain packed format (the state is
+        # fully addressable, engine/_write_checkpoint's other branch) —
+        # accept it here so one-host and pod checkpoints interoperate
+        packed, turn, rule, word_axis = load_packed_checkpoint(npz_path(path))
+        arr = jax.make_array_from_process_local_data(
+            sharding, packed, packed.shape
+        )
+        return arr, turn, rule, word_axis
+    with np.load(p, allow_pickle=False) as data:
+        if "packed" not in data or "row0" not in data:
+            raise ValueError(f"{p} is not a sharded packed checkpoint")
+        if int(data["num_processes"]) != nprocs:
+            raise ValueError(
+                f"{p} was written by {int(data['num_processes'])} processes; "
+                f"this job has {nprocs}"
+            )
+        rows = data["packed"].astype(np.int32)
+        row0 = int(data["row0"])
+        word_axis = int(data["word_axis"])
+        turn = int(data["turn"])
+        rule = LifeRule.from_rulestring(str(data["rulestring"]))
+        gshape = (int(data["global_rows"]), int(data["global_cols"]))
+    idx_map = sharding.addressable_devices_indices_map(gshape)
+    want_row0 = min(idx[0].start or 0 for idx in idx_map.values())
+    if row0 != want_row0:
+        raise ValueError(
+            f"shard {p} holds rows from {row0} but this rank's mesh "
+            f"placement starts at {want_row0}: process/mesh order changed "
+            "since the checkpoint was written"
+        )
+    if nprocs > 1:
+        from jax.experimental import multihost_utils
+
+        turns = multihost_utils.process_allgather(np.int64(turn))
+        if int(turns.min()) != int(turns.max()):
+            raise ValueError(
+                f"checkpoint shards disagree on the turn "
+                f"({int(turns.min())}..{int(turns.max())}): a crash "
+                "between per-rank writes left a mixed set; restore from "
+                "an older consistent checkpoint"
+            )
+    arr = jax.make_array_from_process_local_data(sharding, rows, gshape)
+    return arr, turn, rule, word_axis
+
+
 def load_packed_checkpoint(path) -> tuple[np.ndarray, int, LifeRule, int]:
     """-> (packed int32 array, turn, rule, word_axis) — the byte loader's
     (board, turn, rule) shape with word_axis appended, so the two loaders

@@ -152,6 +152,12 @@ class EngineConfig:
     # reference has only the manual 's' snapshot (gol/distributor.go:78).
     checkpoint_every: int = 0  # 0: disabled
     checkpoint_path: Optional[str] = None
+    # called between chunk dispatches as chunk_hook(engine, state, turn) —
+    # the multi-host control plane's gate (parallel collectives, keypress
+    # broadcast, coordinated pause; see pod.py). Every rank of an SPMD job
+    # reaches the hook at the same (turn) sequence because multi-host
+    # chunk growth is deterministic (see run()).
+    chunk_hook: Optional[Callable] = None
 
 
 class Engine:
@@ -286,6 +292,23 @@ class Engine:
             # loop initialised must still take effect (they are consumed /
             # cleared when this run ends)
 
+        # a multi-host (SPMD) run: every rank executes this same loop and
+        # every jax collective must be issued in the same order on every
+        # rank — so chunk growth must not depend on rank-local wall clocks
+        multihost = not getattr(self._state, "is_fully_addressable", True)
+        if multihost and self.config.checkpoint_every:
+            # packed planes checkpoint per-rank shards; anything else has
+            # no multi-host checkpoint format — fail at entry, not hours in
+            if getattr(self._plane, "word_axis", None) is None:
+                with self._lock:
+                    self._running = False
+                    self._control.notify_all()
+                raise ValueError(
+                    "checkpoint_every on a multi-host state needs a packed "
+                    "bitboard plane (per-rank shard checkpoints); this "
+                    "plane has no word_axis"
+                )
+
         try:
             if emit_flips and emit is not None:
                 for c in alive_cells(world):
@@ -330,9 +353,14 @@ class Engine:
                         inflight.popleft().block_until_ready()
                 elapsed = time.monotonic() - t0
                 if growing:
-                    if (
-                        chunk >= self.config.max_chunk
-                        or elapsed >= self.config.target_dispatch_seconds
+                    if chunk >= self.config.max_chunk or (
+                        # the wall-clock cap is rank-local: on a multi-host
+                        # mesh it could end growth at different sizes on
+                        # different ranks, desynchronising the SPMD dispatch
+                        # sequence — growth there is pure doubling to
+                        # max_chunk (callers bound latency via max_chunk)
+                        not multihost
+                        and elapsed >= self.config.target_dispatch_seconds
                     ):
                         # whichever way doubling ends — size cap or wall-
                         # clock cap — later chunks go async; the pipelined
@@ -357,6 +385,13 @@ class Engine:
                     for y, x in zip(*changed):
                         emit(CellFlipped(turn_now, Cell(int(x), int(y))))
                     emit(TurnComplete(turn_now))
+
+                if self.config.chunk_hook is not None:
+                    # the multi-host control gate: collectives + rank-0
+                    # keypress fan-out happen here, at the same (turn)
+                    # point on every rank (pod.py). A hook that blocks IS
+                    # a pause: the dispatch loop cannot advance past it.
+                    self.config.chunk_hook(self, new_state, turn_now)
 
                 every = self.config.checkpoint_every
                 if every and turn_now // every > (turn_now - n) // every:
@@ -406,19 +441,45 @@ class Engine:
         the previous checkpoint intact."""
         import pathlib
 
-        from .checkpoint import npz_path, save_checkpoint, save_packed_checkpoint
+        from .checkpoint import (
+            npz_path,
+            save_checkpoint,
+            save_packed_checkpoint,
+            save_packed_checkpoint_sharded,
+        )
 
-        if not getattr(state, "is_fully_addressable", True):
-            # multi-host global states can't materialise on one rank, and
-            # every rank writing the same path would clash — periodic
-            # checkpointing is a single-host feature for now
-            return
         # the ACTIVE plane's rule, not the config's: an explicit
         # plane=BitPlane(HIGHLIFE) run must not stamp a Conway checkpoint
         rule = getattr(self._plane, "rule", self.config.rule)
         path = pathlib.Path(self.config.checkpoint_path or "out/engine_ck.npz")
-        tmp = path.with_name(path.name + ".tmp")
         word_axis = getattr(self._plane, "word_axis", None)
+        if not getattr(state, "is_fully_addressable", True):
+            # multi-host: each rank writes only its own word rows, to a
+            # rank-suffixed shard (atomic rename inside) — run() entry
+            # already guaranteed the plane is packed (word_axis set).
+            # Success is agreed COLLECTIVELY: a rank-local failure must
+            # surface on every rank (the operator watches rank 0), and
+            # the resulting mixed-turn shard set must not look like a
+            # success anywhere. Every rank reaches this crossing at the
+            # same turn (deterministic multi-host chunking), so the
+            # allgather is in identical program order.
+            ok, err = 1, None
+            try:
+                save_packed_checkpoint_sharded(path, state, turn, rule, word_axis)
+            except OSError as exc:
+                ok, err = 0, exc
+            from jax.experimental import multihost_utils
+
+            oks = multihost_utils.process_allgather(np.int64(ok))
+            failed = int(len(oks) - oks.sum())
+            if failed:
+                raise err if err is not None else OSError(
+                    f"checkpoint at turn {turn}: shard write failed on "
+                    f"{failed} other rank(s); the on-disk set is mixed "
+                    "until the next successful crossing"
+                )
+            return
+        tmp = path.with_name(path.name + ".tmp")
         if word_axis is not None and hasattr(state, "dtype") and state.dtype == np.int32:
             written = save_packed_checkpoint(tmp, state, turn, rule, word_axis)
         else:

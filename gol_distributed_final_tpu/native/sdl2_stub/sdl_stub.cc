@@ -1,0 +1,77 @@
+// No-op SDL2 implementation backing sdl2_stub/SDL2/SDL.h — see the header
+// for why this exists. Window/renderer/texture handles are distinct dummy
+// non-null pointers; SDL_PollEvent drains a small injectable queue so
+// window.cc's golwin_poll_key switch runs for real.
+
+#include <SDL2/SDL.h>
+
+namespace {
+SDL_Event g_queue[64];
+int g_head = 0;
+int g_tail = 0;
+long g_renders = 0;
+
+void push(const SDL_Event& e) {
+  if ((g_tail + 1) % 64 == g_head) return;  // full: drop (test-only queue)
+  g_queue[g_tail] = e;
+  g_tail = (g_tail + 1) % 64;
+}
+}  // namespace
+
+extern "C" {
+
+int SDL_Init(uint32_t) { return 0; }
+void SDL_Quit(void) {}
+
+SDL_Window* SDL_CreateWindow(const char*, int, int, int, int, uint32_t) {
+  static int dummy;
+  return reinterpret_cast<SDL_Window*>(&dummy);
+}
+void SDL_DestroyWindow(SDL_Window*) {}
+
+SDL_Renderer* SDL_CreateRenderer(SDL_Window*, int, uint32_t) {
+  static int dummy;
+  return reinterpret_cast<SDL_Renderer*>(&dummy);
+}
+void SDL_DestroyRenderer(SDL_Renderer*) {}
+
+SDL_Texture* SDL_CreateTexture(SDL_Renderer*, uint32_t, int, int, int) {
+  static int dummy;
+  return reinterpret_cast<SDL_Texture*>(&dummy);
+}
+void SDL_DestroyTexture(SDL_Texture*) {}
+
+int SDL_UpdateTexture(SDL_Texture*, const SDL_Rect*, const void*, int) {
+  return 0;
+}
+int SDL_RenderClear(SDL_Renderer*) { return 0; }
+int SDL_RenderCopy(SDL_Renderer*, SDL_Texture*, const SDL_Rect*,
+                   const SDL_Rect*) {
+  return 0;
+}
+void SDL_RenderPresent(SDL_Renderer*) { g_renders++; }
+
+int SDL_PollEvent(SDL_Event* event) {
+  if (g_head == g_tail) return 0;
+  *event = g_queue[g_head];
+  g_head = (g_head + 1) % 64;
+  return 1;
+}
+
+void sdl_stub_push_key(int sym) {
+  SDL_Event e;
+  e.type = SDL_KEYDOWN;
+  e.key.keysym.sym = sym;
+  push(e);
+}
+
+void sdl_stub_push_quit(void) {
+  SDL_Event e;
+  e.type = SDL_QUIT;
+  e.key.keysym.sym = 0;
+  push(e);
+}
+
+long sdl_stub_render_count(void) { return g_renders; }
+
+}  // extern "C"

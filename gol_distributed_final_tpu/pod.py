@@ -1,0 +1,521 @@
+"""Config 5 at its real topology: the packed big board on a MULTI-HOST mesh.
+
+The reference's whole scaling story is "add machines to the list"
+(broker/broker.go:288-300) — every machine then holds the full board.
+Here the opposite: a ``jax.distributed`` job shards the packed bitboard
+over a global ('rows', 'cols') mesh (parallel/bit_halo.ShardedBitPlane —
+halo ppermutes ride ICI/DCN), and every host-side surface touches only the
+rows its devices own:
+
+* ``stream_packed_to_pgm_sharded`` / ``load_packed_from_pgm_sharded`` —
+  each rank packs/unpacks ONLY its word rows, pwriting/reading disjoint
+  ranges of one on-disk PGM (io/sharded.py). The byte raster never exists
+  anywhere; peak host memory is one row block per rank.
+* periodic crash-recovery checkpoints — per-rank shards
+  (engine/checkpoint.save_packed_checkpoint_sharded), written between
+  chunk dispatches by every rank at the same deterministic turn.
+* ``pod_session`` — the reference session surface (2-second
+  ``AliveCellsCount``, the s/q/k/p keyboard semantics, the closing
+  ``FinalTurnComplete`` -> PGM -> ``ImageOutputComplete`` ->
+  ``StateChange{Quitting}`` -> CLOSED sequence; gol/distributor.go:25-129)
+  on the pod. Control is rank-0-driven: keypresses and the tick timer live
+  on rank 0 only, and every decision is fanned out to all ranks through a
+  small broadcast at the engine's chunk gate (EngineConfig.chunk_hook), so
+  every collective — counts, snapshot streams, the pause barrier — runs in
+  the same order on every rank. A blocked gate IS the pause: the dispatch
+  loop cannot advance past it.
+
+Single-host states pass through unchanged: the module's IO entry points
+fall back to bigboard.py's local streaming when the state is fully
+addressable, so the same program text serves one chip and a pod.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import numpy as np
+
+from .models import CONWAY, LifeRule
+from .ops.bitpack import WORD, alive_count_packed
+
+# control word bits broadcast from rank 0 at each chunk gate
+_CTL_TICK = 1  # all ranks join the count collective; rank 0 emits the event
+_CTL_SNAPSHOT = 2  # all ranks stream their rows to the session PGM
+_CTL_PAUSE = 4  # enter/stay in the pause barrier
+_CTL_QUIT = 8  # engine.quit() on every rank
+
+
+def _packed_dims(shape, word_axis: int) -> tuple[int, int]:
+    rows, cols = shape
+    return (rows * WORD, cols) if word_axis == 0 else (rows, cols * WORD)
+
+
+def _broadcast_word(word: int) -> int:
+    from jax.experimental import multihost_utils
+
+    return int(multihost_utils.broadcast_one_to_all(np.int32(word)))
+
+
+def _barrier(name: str) -> None:
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
+def stream_packed_to_pgm_sharded(
+    path, state, word_axis: int = 0, row_block: int = 1024
+) -> None:
+    """Write a mesh-sharded packed board to ONE on-disk P5 PGM, each rank
+    pwriting only the rows it owns (io/sharded.py disjoint ranges). Falls
+    back to the local streamer for fully-addressable states. Collective:
+    every rank must call it (two barriers inside).
+
+    Matches the reference's output contract (gol/io.go:42-87) at a scale
+    the reference cannot reach: no process ever holds more than
+    ``row_block`` unpacked rows."""
+    from .bigboard import stream_packed_to_pgm
+
+    if getattr(state, "is_fully_addressable", True):
+        stream_packed_to_pgm(path, state, word_axis, row_block)
+        return
+
+    import jax
+
+    from .engine.checkpoint import local_packed_rows
+    from .io.sharded import create_pgm, pgm_raster_offset, write_rows_at
+    from .ops.bitpack import unpack_device
+
+    height, width = _packed_dims(state.shape, word_axis)
+    row_block = max(WORD, row_block - row_block % WORD)
+    if jax.process_index() == 0:
+        offset = create_pgm(path, width, height)
+    else:
+        offset = pgm_raster_offset(width, height)
+    # rank != 0 must not pwrite before the file exists at full size
+    _barrier("pod_pgm_created")
+
+    word_row0, local = local_packed_rows(state)
+    board_row0 = word_row0 * WORD if word_axis == 0 else word_row0
+    step = row_block // WORD if word_axis == 0 else row_block
+    for start in range(0, local.shape[0], step):
+        block = local[start : start + step]
+        rows = np.asarray(unpack_device(block, word_axis))
+        write_rows_at(
+            path,
+            offset,
+            width,
+            board_row0 + (start * WORD if word_axis == 0 else start),
+            rows,
+        )
+    _barrier("pod_pgm_written")
+
+
+def load_packed_from_pgm_sharded(
+    path, mesh, word_axis: int = 0, row_block: int = 1024, rule=None
+):
+    """Stream a P5 PGM into a mesh-sharded packed board: each rank reads
+    ONLY its own board rows from disk (io/sharded.read_shard), packs them
+    locally, and places the block onto the global mesh. Collective."""
+    import jax
+    import jax.numpy as jnp
+
+    from .io.pgm import PgmReader
+    from .io.sharded import read_shard
+    from .ops.bitpack import pack_device
+    from .parallel.bit_halo import packed_sharding
+    from .parallel.multihost import host_row_range
+
+    with PgmReader(path) as r:
+        width, height = r.width, r.height
+    if height % WORD or width % WORD:
+        raise ValueError(f"{width}x{height} not divisible by {WORD}")
+    lo, hi = host_row_range(mesh, height)
+    row_block = max(WORD, row_block - row_block % WORD)
+    blocks = []
+    for start in range(lo, hi, row_block):
+        stop = min(start + row_block, hi)
+        rows = read_shard(path, start, stop)
+        blocks.append(np.asarray(pack_device(jnp.asarray(rows), word_axis)))
+    local = np.concatenate(blocks, axis=0)
+    if word_axis == 0:
+        gshape = (height // WORD, width)
+    else:
+        gshape = (height, width // WORD)
+    return jax.make_array_from_process_local_data(
+        packed_sharding(mesh), local, gshape
+    )
+
+
+class _PodControl:
+    """The rank-0-driven control gate installed as EngineConfig.chunk_hook.
+
+    Rank 0 turns its local state (tick timer, drained keypresses) into a
+    control word; ``multihost_utils.broadcast_one_to_all`` fans it to all
+    ranks, which act identically. The pause barrier is a loop of further
+    broadcasts — rank 0 re-polling its keyboard between them — so parked
+    ranks stay rendezvoused with rank 0 until resume or quit."""
+
+    def __init__(
+        self,
+        params,
+        events,
+        keypresses,
+        out_path,
+        word_axis: int,
+        row_block: int,
+        tick_seconds: float,
+        is_root: bool,
+    ):
+        self.params = params
+        self.events = events
+        self.keypresses = keypresses
+        self.out_path = out_path
+        self.word_axis = word_axis
+        self.row_block = row_block
+        self.tick_seconds = tick_seconds
+        self.is_root = is_root
+        self.paused = False
+        self._next_tick = time.monotonic() + tick_seconds
+
+    # -- rank-0 side -------------------------------------------------------
+
+    def _drain_key_word(self) -> int:
+        import queue as queue_mod
+
+        word = 0
+        if self.keypresses is None:
+            return word
+        while True:
+            try:
+                key = self.keypresses.get_nowait()
+            except queue_mod.Empty:
+                return word
+            if key == "s":
+                word |= _CTL_SNAPSHOT
+            elif key == "p":
+                # XOR, not OR: two presses drained at one gate cancel out
+                # (pause + immediate resume), as two toggles should
+                word ^= _CTL_PAUSE
+            elif key in ("q", "k"):
+                word |= _CTL_QUIT
+
+    def _root_word(self) -> int:
+        word = self._drain_key_word()
+        if time.monotonic() >= self._next_tick:
+            self._next_tick = time.monotonic() + self.tick_seconds
+            word |= _CTL_TICK
+        return word
+
+    # -- every rank --------------------------------------------------------
+
+    def __call__(self, engine, state, turn: int) -> None:
+        word = _broadcast_word(self._root_word() if self.is_root else 0)
+        self._apply(engine, state, turn, word)
+        while self.paused and not (word & _CTL_QUIT):
+            # the pause barrier: the gate does not return, so no rank can
+            # dispatch another chunk (broker/broker.go:83-86's blocked
+            # loop, pod-wide). Rank 0 paces the rendezvous.
+            if self.is_root:
+                time.sleep(0.05)
+                word = _broadcast_word(self._drain_key_word())
+            else:
+                word = _broadcast_word(0)
+            self._apply(engine, state, turn, word)
+
+    def _apply(self, engine, state, turn: int, word: int) -> None:
+        from .events import AliveCellsCount, Quitting, State, StateChange
+
+        if word & _CTL_TICK:
+            # EVERY rank joins the count collective (allgathered row
+            # popcounts); only rank 0 emits — and, like the reference's
+            # ticker, not while paused (gol/distributor.go:47)
+            count = alive_count_packed(state)
+            if self.is_root and not self.paused:
+                self.events.put(AliveCellsCount(turn, count))
+        if word & _CTL_SNAPSHOT:
+            stream_packed_to_pgm_sharded(
+                self.out_path, state, self.word_axis, self.row_block
+            )
+            if self.is_root:
+                print(self.params.output_filename)
+        if word & _CTL_PAUSE:
+            self.paused = not self.paused
+            if self.is_root:
+                self.events.put(
+                    StateChange(
+                        turn if self.paused else turn - 1,
+                        State.PAUSED if self.paused else State.EXECUTING,
+                    )
+                )
+                print("State paused" if self.paused else "State unpaused")
+        if word & _CTL_QUIT:
+            if self.is_root:
+                self.events.put(StateChange(turn, Quitting))
+            engine.quit()
+
+
+class _CountOnlyAlive:
+    """``FinalTurnComplete.alive`` for a pod run: the global count without
+    any rank materialising cells it does not own. Iteration is refused —
+    a pod-scale cell list is exactly what this surface promises never to
+    build (the count was computed collectively before emission)."""
+
+    def __init__(self, count: int):
+        self._count = count
+
+    def __len__(self) -> int:
+        return self._count
+
+    def __iter__(self):
+        raise NotImplementedError(
+            "a multi-host FinalTurnComplete carries only the count; decode "
+            "windows via bigboard.decode_window or stream the PGM instead"
+        )
+
+
+def pod_session(
+    size: int,
+    turns: int,
+    mesh,
+    *,
+    in_path=None,
+    cells=None,
+    rule: LifeRule = CONWAY,
+    row_block: int = 1024,
+    events=None,
+    keypresses=None,
+    tick_seconds: float = 2.0,
+    out_dir="out",
+    checkpoint_every: int = 0,
+    checkpoint_path=None,
+    resume_from=None,
+    min_chunk: int = 16,
+    max_chunk: int = 256,
+):
+    """The full reference session surface over a multi-host packed board.
+
+    Collective: every rank of the ``jax.distributed`` job calls this with
+    the same arguments; ``events``/``keypresses`` are only consulted on
+    rank 0 (the controller host). Returns the engine's RunResult (world is
+    None; ``alive`` is count-only on every rank).
+
+    ``resume_from`` continues from a per-rank sharded checkpoint
+    (engine/checkpoint.load_packed_checkpoint_sharded) — combined with
+    ``checkpoint_every`` this is the pod crash-recovery loop.
+
+    Reference anchors: the session event contract gol/distributor.go:25-129
+    + the scale-by-adding-machines story broker/broker.go:288-300."""
+    import pathlib
+    import queue as queue_mod
+
+    import jax
+
+    from .engine.controller import CLOSED
+    from .engine.engine import Engine, EngineConfig
+    from .events import (
+        FinalTurnComplete,
+        ImageOutputComplete,
+        Quitting,
+        StateChange,
+    )
+    from .params import Params
+    from .parallel.bit_halo import ShardedBitPlane, choose_bit_layout, packed_sharding
+    from .parallel.mesh import COLS, ROWS
+    from .parallel.multihost import host_row_range
+
+    is_root = jax.process_index() == 0
+    if events is None:
+        events = queue_mod.Queue()
+    try:
+        mesh_shape = (mesh.shape[ROWS], mesh.shape[COLS])
+        word_axis = choose_bit_layout((size, size), mesh_shape)
+        if word_axis is None:
+            raise ValueError(
+                f"no packed layout of {size}x{size} divides over mesh "
+                f"{mesh_shape}"
+            )
+        params = Params(turns=turns, image_width=size, image_height=size)
+        out_file = pathlib.Path(out_dir) / f"{params.output_filename}.pgm"
+
+        initial_turn = 0
+        if resume_from is not None:
+            from .engine.checkpoint import load_packed_checkpoint_sharded
+
+            state, initial_turn, ck_rule, ck_axis = load_packed_checkpoint_sharded(
+                resume_from, packed_sharding(mesh)
+            )
+            if ck_axis != word_axis:
+                raise ValueError(
+                    f"checkpoint word_axis {ck_axis} != layout {word_axis}"
+                )
+            if ck_rule.rulestring != rule.rulestring:
+                raise ValueError(
+                    f"checkpoint rule {ck_rule.rulestring} != {rule.rulestring}"
+                )
+            if turns <= initial_turn:
+                raise ValueError(
+                    f"turns={turns} not beyond checkpoint turn {initial_turn}"
+                )
+        elif in_path is not None:
+            state = load_packed_from_pgm_sharded(
+                in_path, mesh, word_axis, row_block
+            )
+        elif cells is not None:
+            from .bigboard import seed_packed
+
+            # sparse seeding is cheap enough to do identically on every
+            # rank, then place: each rank keeps only its addressable rows
+            host_local = np.asarray(seed_packed(size, cells, word_axis))
+            lo, hi = host_row_range(mesh, size)
+            wlo, whi = (
+                (lo // WORD, hi // WORD) if word_axis == 0 else (lo, hi)
+            )
+            state = jax.make_array_from_process_local_data(
+                packed_sharding(mesh), host_local[wlo:whi], host_local.shape
+            )
+        else:
+            raise ValueError("one of resume_from / in_path / cells is required")
+
+        plane = ShardedBitPlane(mesh, rule, word_axis)
+        control = _PodControl(
+            params,
+            events,
+            keypresses,
+            out_file,
+            word_axis,
+            row_block,
+            tick_seconds,
+            is_root,
+        )
+        engine = Engine(
+            EngineConfig(
+                rule=rule,
+                final_world=False,
+                min_chunk=min_chunk,
+                max_chunk=max_chunk,
+                chunk_hook=control,
+                checkpoint_every=checkpoint_every,
+                checkpoint_path=str(checkpoint_path) if checkpoint_path else None,
+            )
+        )
+        result = engine.run(
+            params,
+            None,
+            plane=plane,
+            initial_state=state,
+            initial_turn=initial_turn,
+        )
+        final = engine.final_state()
+        # the closing sequence (gol/distributor.go:161-184), pod-shaped:
+        # count collectively, stream the PGM per rank, emit on rank 0
+        count = alive_count_packed(final)
+        # pre-fill the result's alive payload with the collectively-agreed
+        # count on EVERY rank: a later rank-local result.alive_count must
+        # not fire a collective outside the gate protocol
+        result._alive = _CountOnlyAlive(count)
+        if is_root:
+            events.put(
+                FinalTurnComplete(result.turns_completed, _CountOnlyAlive(count))
+            )
+        stream_packed_to_pgm_sharded(out_file, final, word_axis, row_block)
+        if is_root:
+            events.put(
+                ImageOutputComplete(
+                    result.turns_completed, params.output_filename
+                )
+            )
+            events.put(StateChange(result.turns_completed, Quitting))
+        return result
+    finally:
+        events.put(CLOSED)
+
+
+def main(argv=None) -> int:
+    """Pod entry point: one invocation per host of the ``jax.distributed``
+    job (the reference's 'go run ./worker on every machine',
+    broker/broker.go:288-300 — except the board is sharded, not copied).
+
+    Rank 0 is the controller host: it owns the tty keys (s/q/k/p) and
+    prints the event stream; other ranks run headless."""
+    import argparse
+    import queue as queue_mod
+    import threading
+
+    import jax
+
+    from .__main__ import drain_events, start_tty_keys
+    from .bigboard import r_pentomino
+    from .parallel import make_mesh, multihost
+
+    parser = argparse.ArgumentParser(
+        description="multi-host packed big-board session (config 5 topology)"
+    )
+    parser.add_argument("-size", type=int, default=16384)
+    parser.add_argument("-turns", type=int, default=1000)
+    parser.add_argument("-in", dest="in_path", default=None,
+                        help="seed PGM (default: the R-pentomino)")
+    parser.add_argument("-out", default="out", help="output directory")
+    parser.add_argument("-row-block", type=int, default=1024)
+    parser.add_argument("-coordinator", default=None,
+                        help="jax.distributed coordinator address host:port")
+    parser.add_argument("-num-processes", type=int, default=1)
+    parser.add_argument("-process-id", type=int, default=0)
+    parser.add_argument("-ck", default=None, metavar="PATH",
+                        help="periodic checkpoint base path (per-rank shards)")
+    parser.add_argument("-ck-every", type=int, default=0)
+    parser.add_argument("-resume", action="store_true", default=False,
+                        help="resume from -ck's per-rank shards")
+    args = parser.parse_args(argv)
+    # fail on argument mistakes BEFORE every host pays jax.distributed
+    # initialisation, with messages that name the flags involved
+    if args.resume and not args.ck:
+        parser.error("-resume needs -ck (the checkpoint base path)")
+    if args.resume and args.in_path:
+        parser.error("-resume restores the board from -ck; drop -in")
+
+    multihost.initialize(
+        args.coordinator, args.num_processes, args.process_id
+    )
+    local = len(jax.local_devices())
+    mesh = make_mesh((jax.process_count(), local))
+    is_root = jax.process_index() == 0
+
+    events: "queue_mod.Queue" = queue_mod.Queue()
+    keypresses: "queue_mod.Queue | None" = None
+    restore_tty = lambda: None
+    consumer = None
+    if is_root:
+        keypresses = queue_mod.Queue()
+        restore_tty = start_tty_keys(keypresses)
+        consumer = threading.Thread(target=drain_events, args=(events,))
+        consumer.start()
+    try:
+        result = pod_session(
+            args.size,
+            args.turns,
+            mesh,
+            in_path=args.in_path,
+            cells=None if (args.in_path or args.resume) else r_pentomino(args.size),
+            row_block=args.row_block,
+            events=events,
+            keypresses=keypresses,
+            out_dir=args.out,
+            checkpoint_every=args.ck_every,
+            checkpoint_path=args.ck,
+            resume_from=args.ck if args.resume else None,
+        )
+    finally:
+        if consumer is not None:
+            consumer.join()
+        restore_tty()
+    if is_root:
+        print(f"alive {result.alive_count}")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -63,11 +63,17 @@ class Window:
 
 
 class SdlWindow(Window):
-    """Native SDL2-backed window (requires libgolwindow.so)."""
+    """Native SDL2-backed window (requires libgolwindow.so).
 
-    def __init__(self, width: int, height: int, title: str = "GoL"):
+    ``lib_path`` overrides the library location — used by the ABI test to
+    load the stub-backed build (libgolwindow_stub.so: the same golwin_*
+    exports over the vendored no-op SDL, native/sdl2_stub/)."""
+
+    def __init__(
+        self, width: int, height: int, title: str = "GoL", lib_path=None
+    ):
         super().__init__(width, height, title)
-        lib = ctypes.CDLL(str(_WINDOW_LIB))
+        lib = ctypes.CDLL(str(lib_path or _WINDOW_LIB))
         # declare EVERY signature: on LP64 an undeclared handle argument
         # would be truncated to a 32-bit c_int (ADVICE/VERDICT round 1)
         lib.golwin_create.restype = ctypes.c_void_p

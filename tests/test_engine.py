@@ -432,3 +432,34 @@ def test_ticker_quits_even_when_broker_is_dead():
         assert ticker.done.is_set()
     finally:
         ticker.stop()
+
+
+def test_multihost_checkpoint_without_packed_plane_raises():
+    """checkpoint_every on a multi-host state whose plane has no packed
+    shard format must fail AT RUN ENTRY, not silently skip every write
+    (VERDICT round 3 item 4) and not hours into a pod run."""
+    import pytest
+
+    from gol_distributed_final_tpu.models import CONWAY
+
+    class FakeGlobalState:
+        is_fully_addressable = False
+
+    class NoWordAxisPlane:
+        rule = CONWAY
+
+        def step_n(self, state, n):
+            raise AssertionError("must not be reached")
+
+    engine = Engine(
+        EngineConfig(final_world=False, checkpoint_every=10)
+    )
+    with pytest.raises(ValueError, match="word_axis"):
+        engine.run(
+            Params(turns=100, image_width=64, image_height=64),
+            None,
+            plane=NoWordAxisPlane(),
+            initial_state=FakeGlobalState(),
+        )
+    # the engine is reusable after the rejected run
+    assert not engine._running

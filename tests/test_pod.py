@@ -1,0 +1,270 @@
+"""pod.py in-process: the multi-host packed session surface exercised on
+the single-process 8-device CPU mesh (process boundaries are covered by
+tests/test_multihost.py::test_two_process_pod_* via real jax.distributed
+children; here the same code paths run fully addressable, which keeps the
+control-plane semantics — gates, ticks, pause barrier, quit, snapshot,
+checkpoint/resume — fast to iterate and deterministic)."""
+
+import queue
+
+import numpy as np
+import pytest
+
+from gol_distributed_final_tpu.engine.controller import CLOSED
+from gol_distributed_final_tpu.events import (
+    AliveCellsCount,
+    FinalTurnComplete,
+    ImageOutputComplete,
+    Quitting,
+    State,
+    StateChange,
+)
+from gol_distributed_final_tpu.parallel import make_mesh
+from gol_distributed_final_tpu.pod import (
+    load_packed_from_pgm_sharded,
+    pod_session,
+    stream_packed_to_pgm_sharded,
+)
+
+from helpers import REPO_ROOT
+from oracle import vector_step
+
+SIZE, TURNS = 256, 20
+
+
+def _random_board(seed=5, size=SIZE):
+    rng = np.random.default_rng(seed)
+    return np.where(rng.random((size, size)) < 0.3, 255, 0).astype(np.uint8)
+
+
+def _write_pgm(path, board):
+    path.parent.mkdir(parents=True, exist_ok=True)
+    h, w = board.shape
+    path.write_bytes(b"P5\n%d %d\n255\n" % (w, h) + board.tobytes())
+
+
+def _oracle(board, turns):
+    for _ in range(turns):
+        board = vector_step(board)
+    return board
+
+
+def _drain(events):
+    seq = []
+    while True:
+        ev = events.get(timeout=60)
+        if ev is CLOSED:
+            return seq
+        seq.append(ev)
+
+
+def test_pod_session_end_to_end(tmp_path):
+    """Seed from a streamed PGM, run the session, and get the reference
+    closing sequence plus a byte-exact streamed output."""
+    board = _random_board()
+    in_path = tmp_path / f"{SIZE}x{SIZE}.pgm"
+    _write_pgm(in_path, board)
+    mesh = make_mesh((2, 4))
+    events = queue.Queue()
+
+    res = pod_session(
+        SIZE,
+        TURNS,
+        mesh,
+        in_path=in_path,
+        events=events,
+        tick_seconds=0.001,  # every gate ticks
+        out_dir=tmp_path / "out",
+        min_chunk=4,
+        max_chunk=4,
+    )
+    seq = _drain(events)
+    want = _oracle(board, TURNS)
+
+    assert res.turns_completed == TURNS
+    ticks = [e for e in seq if isinstance(e, AliveCellsCount)]
+    assert ticks, "no AliveCellsCount gates fired"
+    # every tick's count is exact for its turn (gates land on chunk
+    # boundaries: turns 4, 8, 12, 16, 20)
+    by_turn = {}
+    b = board
+    for t in range(1, TURNS + 1):
+        b = vector_step(b)
+        by_turn[t] = int(np.count_nonzero(b))
+    for e in ticks:
+        assert e.cells_count == by_turn[e.completed_turns]
+    final = [e for e in seq if isinstance(e, FinalTurnComplete)]
+    assert len(final) == 1
+    assert len(final[0].alive) == int(np.count_nonzero(want))
+    with pytest.raises(NotImplementedError):
+        list(final[0].alive)  # pod runs never materialise the cell list
+    assert isinstance(seq[-2], ImageOutputComplete)
+    assert (
+        isinstance(seq[-1], StateChange) and seq[-1].new_state is Quitting
+    )
+
+    got = (tmp_path / "out" / f"{SIZE}x{SIZE}x{TURNS}.pgm").read_bytes()
+    assert got == b"P5\n%d %d\n255\n" % (SIZE, SIZE) + want.tobytes()
+
+
+def test_pod_session_pause_snapshot_quit(tmp_path):
+    """The keyboard surface through the chunk gate: 's' streams a
+    snapshot, 'p'/'p' pause and resume (with the turn-1 resume quirk and
+    tick suppression while paused), 'q' quits early."""
+    import threading
+    import time
+
+    board = _random_board(6)
+    in_path = tmp_path / f"{SIZE}x{SIZE}.pgm"
+    _write_pgm(in_path, board)
+    mesh = make_mesh((2, 4))
+    events = queue.Queue()
+    keys = queue.Queue()
+
+    # feed keys with pacing from a thread: snapshot early, then a pause
+    # long enough to prove frozen ticks, resume, quit
+    def feed():
+        keys.put("s")
+        time.sleep(0.4)
+        keys.put("p")
+        time.sleep(0.5)
+        keys.put("p")
+        time.sleep(0.2)
+        keys.put("q")
+
+    feeder = threading.Thread(target=feed)
+    feeder.start()
+    res = pod_session(
+        SIZE,
+        1_000_000,  # 'q' must end it
+        mesh,
+        in_path=in_path,
+        events=events,
+        keypresses=keys,
+        tick_seconds=0.05,
+        out_dir=tmp_path / "out",
+        min_chunk=2,
+        max_chunk=2,
+    )
+    feeder.join()
+    seq = _drain(events)
+    assert 0 < res.turns_completed < 1_000_000
+
+    changes = [e for e in seq if isinstance(e, StateChange)]
+    paused = [e for e in changes if e.new_state == State.PAUSED]
+    executing = [e for e in changes if e.new_state == State.EXECUTING]
+    assert len(paused) == 1 and len(executing) == 1
+    # the gate is the pause barrier: the turn cannot move between the
+    # pause and resume events, so the quirk arithmetic is exact here
+    assert executing[0].completed_turns == paused[0].completed_turns - 1
+    # ticks are suppressed while paused: no AliveCellsCount strictly
+    # between the two StateChanges
+    i0, i1 = seq.index(paused[0]), seq.index(executing[0])
+    assert not any(
+        isinstance(e, AliveCellsCount) for e in seq[i0 + 1 : i1]
+    ), "tick emitted while paused"
+    quits = [e for e in changes if e.new_state is Quitting]
+    assert len(quits) == 2  # one from 'q', one from the closing sequence
+    # the snapshot (and later the final write) landed at the session path
+    assert (tmp_path / "out" / f"{SIZE}x{SIZE}x1000000.pgm").exists()
+
+
+def test_pod_checkpoint_and_resume(tmp_path):
+    """Periodic per-rank checkpoints + resume: interrupt nothing, just
+    verify the turn-16 checkpoint a 20-turn run leaves behind resumes to
+    a byte-identical final board."""
+    board = _random_board(7)
+    in_path = tmp_path / f"{SIZE}x{SIZE}.pgm"
+    _write_pgm(in_path, board)
+    mesh = make_mesh((2, 4))
+    ck = tmp_path / "podck.npz"
+
+    res = pod_session(
+        SIZE,
+        TURNS,
+        mesh,
+        in_path=in_path,
+        events=queue.Queue(),
+        tick_seconds=3600,
+        out_dir=tmp_path / "out",
+        checkpoint_every=8,
+        checkpoint_path=ck,
+        min_chunk=4,
+        max_chunk=4,
+    )
+    assert res.turns_completed == TURNS
+
+    from gol_distributed_final_tpu.engine.checkpoint import (
+        load_packed_checkpoint_sharded,
+    )
+    from gol_distributed_final_tpu.parallel.bit_halo import packed_sharding
+
+    # a single-process run's state is fully addressable, so the engine
+    # wrote the PLAIN packed format; the sharded loader accepts it (the
+    # one-host <-> pod interop path), holding the LAST mid-run crossing
+    assert ck.exists()
+    state, turn, rule, word_axis = load_packed_checkpoint_sharded(
+        ck, packed_sharding(mesh)
+    )
+    assert turn == 16 and rule.rulestring == "B3/S23" and word_axis == 0
+
+    res2 = pod_session(
+        SIZE,
+        TURNS,
+        mesh,
+        resume_from=ck,
+        events=queue.Queue(),
+        tick_seconds=3600,
+        out_dir=tmp_path / "out2",
+        min_chunk=4,
+        max_chunk=4,
+    )
+    assert res2.turns_completed == TURNS
+    direct = (tmp_path / "out" / f"{SIZE}x{SIZE}x{TURNS}.pgm").read_bytes()
+    resumed = (tmp_path / "out2" / f"{SIZE}x{SIZE}x{TURNS}.pgm").read_bytes()
+    assert resumed == direct
+    want = _oracle(board, TURNS)
+    assert direct == b"P5\n%d %d\n255\n" % (SIZE, SIZE) + want.tobytes()
+
+
+def test_pod_sharded_pgm_roundtrip(tmp_path):
+    """load_packed_from_pgm_sharded -> stream_packed_to_pgm_sharded is an
+    identity on the bytes, and the loaded state is the mesh-sharded
+    packing of the on-disk board."""
+    board = _random_board(8)
+    in_path = tmp_path / f"{SIZE}x{SIZE}.pgm"
+    _write_pgm(in_path, board)
+    mesh = make_mesh((2, 4))
+    state = load_packed_from_pgm_sharded(in_path, mesh)
+    from gol_distributed_final_tpu.ops.bitpack import (
+        alive_count_packed,
+        pack,
+    )
+
+    np.testing.assert_array_equal(np.asarray(state), pack(board, 0))
+    assert alive_count_packed(state) == int(np.count_nonzero(board))
+    out = tmp_path / "round.pgm"
+    stream_packed_to_pgm_sharded(out, state, row_block=64)
+    assert out.read_bytes() == in_path.read_bytes()
+
+
+def test_pod_session_rejects_stale_resume(tmp_path):
+    """A resume whose turns target is not beyond the checkpoint, or whose
+    rule disagrees, is rejected before anything runs."""
+    from gol_distributed_final_tpu.bigboard import seed_packed
+    from gol_distributed_final_tpu.engine.checkpoint import (
+        save_packed_checkpoint_sharded,
+    )
+    from gol_distributed_final_tpu.models import HIGHLIFE
+
+    mesh = make_mesh((2, 4))
+    ck = tmp_path / "ck.npz"
+    state = seed_packed(SIZE, [(10, 10), (11, 10), (12, 10)])
+    save_packed_checkpoint_sharded(ck, state, 30)
+    with pytest.raises(ValueError, match="not beyond"):
+        pod_session(SIZE, 30, mesh, resume_from=ck, events=queue.Queue())
+    with pytest.raises(ValueError, match="rule"):
+        pod_session(
+            SIZE, 60, mesh, resume_from=ck, rule=HIGHLIFE,
+            events=queue.Queue(),
+        )

@@ -183,11 +183,9 @@ def test_window_keypresses_drive_full_session(tmp_path):
         # teeing events through a wrapper queue
         viz_run(p, events, keypresses, window=window)
 
-    viz_thread = threading.Thread(target=consume_and_forward)
-    viz_thread.start()
-
     # tee: collect events for assertions while the viz loop drains them —
-    # wrap the queue's get so both see the stream
+    # wrap the queue's get so both see the stream. Installed BEFORE the
+    # viz thread starts so not even the first event can bypass the tee.
     orig_get = events.get
 
     def tee_get(*a, **kw):
@@ -196,6 +194,9 @@ def test_window_keypresses_drive_full_session(tmp_path):
         return ev
 
     events.get = tee_get
+
+    viz_thread = threading.Thread(target=consume_and_forward)
+    viz_thread.start()
 
     result = run(
         p,
