@@ -225,7 +225,15 @@ def main() -> int:
     evolve128(n_lo), evolve128(n_hi)
     pt128, det128 = marginal(evolve128, n_lo, n_hi, "c2_128_pallas_bitboard")
     extra["c2_128_pallas_bitboard"] = dict(
-        det128, cell_updates_per_s=round(128 * 128 / pt128)
+        det128,
+        cell_updates_per_s=round(128 * 128 / pt128),
+        # the small-board floor is the serial latency of one turn's ~39-op
+        # bit-plane dependency chain, NOT loop overhead: an unroll sweep
+        # (u=1..32, r4) measured u>=2 flat at ~100 ns/turn while 512^2
+        # with 16x the cells costs only ~1.5x — full account in
+        # ops/pallas_stencil.py::_bit_kernel
+        floor_note="latency-bound: serial per-turn op chain; unroll sweep "
+        "u>=2 flat (see ops/pallas_stencil._bit_kernel)",
     )
 
     # ---- config 4: 4096^2 (grid-tiled pallas beyond the whole-board gate) -

@@ -161,10 +161,27 @@ def _bit_kernel(
             b, word_axis, rot1, birth_mask=birth_mask, survive_mask=survive_mask
         )
 
-    # two turns per loop iteration: at VMEM-resident sizes the fori_loop's
-    # per-iteration overhead is ~17% of a turn (measured 154 -> 129 ns/turn
-    # at 512^2 on v5e; deeper unrolls regressed — register pressure), and
-    # Mosaic's fori_loop rejects partial `unroll`, so unroll by hand
+    # Two turns per loop iteration: the fori_loop's per-iteration
+    # bookkeeping costs ~one turn-fraction (u=1 -> u=2 measured
+    # 123 -> ~100 ns/turn at 128^2, 169 -> ~154 at 512^2 on v5e), and
+    # Mosaic's fori_loop rejects partial `unroll`, so unroll by hand.
+    #
+    # Why not deeper, and why the SMALL-board floor is what it is
+    # (BENCH c2, 128^2 ~0.10 us/turn vs 512^2 ~0.15 for 16x the cells):
+    # a full unroll sweep u in {1,2,4,8,16,32} at 128^2 and u up to 64 at
+    # 512^2 (r4 session, marginal fits over 2M turns, every point
+    # parity-checked) measured u>=2 indistinguishable at both sizes
+    # (128^2: ~100 +-5 ns across u=2..32; 512^2: 150-154 ns across
+    # u=2..64). So past u=2 loop overhead is invisible, and the ~100 ns
+    # floor at 128^2 is the SERIAL LATENCY of one turn's ~39-operation
+    # bit-plane dependency chain: turns are sequentially dependent, so no
+    # unroll can overlap them, and a 128^2 packed board is 512 int32
+    # words — HALF one (8,128) int32 vreg — so the VPU finishes each
+    # op's data in a single issue, making the chain's issue latency, not
+    # throughput, the bound. 512^2 (8 vregs, 16x the work) costing only
+    # ~1.5x per turn confirms throughput is nearly free at these sizes.
+    # Shrinking the chain itself is the only lever left, and bit_step is
+    # already pruned to the CSA minimum (ops/bitpack.py).
     out = lax.fori_loop(0, n // 2, lambda _, b: step(step(b)), packed_ref[:])
     if n % 2:
         out = step(out)

@@ -1,0 +1,29 @@
+"""Real-TPU kernel correctness subset (VERDICT round 3 item 7).
+
+Run ON HARDWARE with one command:
+
+    python -m pytest tests_tpu -q
+
+Unlike ``tests/`` (whose conftest forces the 8-device virtual CPU mesh and
+pallas interpret mode), this suite runs the Mosaic-COMPILED kernels on the
+real chip — kernel correctness independent of bench.py's parity gates, and
+under rules/packings the bench never exercises. Off-TPU every test skips
+itself (the platform check lives in the test module), so the same command
+is safe anywhere.
+
+Deliberately defines no shared symbols: importing names from a module
+called ``conftest`` is ambiguous under pytest's importlib mode (tests/
+has a conftest too), so the test modules are self-contained.
+"""
+
+import pathlib
+import sys
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "tpu: runs Mosaic-compiled kernels on real TPU hardware"
+    )
