@@ -86,7 +86,29 @@ def main(argv=None) -> int:
              "images/<W>x<H>.pgm at turn 0; with -server the checkpoint's "
              "board, turn, and rule are shipped to the remote broker",
     )
+    parser.add_argument(
+        "-rule", default=None, metavar="B.../S...",
+        help="life-like rulestring (default Conway B3/S23); shipped to the "
+             "broker with -server (the workers backend computes Conway only)",
+    )
+    parser.add_argument(
+        "-trace", default=None, metavar="DIR",
+        help="wrap the session in a jax.profiler trace written to DIR "
+             "(the reference's TestTrace role, trace_test.go:12-29)",
+    )
     args = parser.parse_args(argv)
+    if args.rule and args.resume:
+        parser.error("-rule conflicts with -resume (the checkpoint's rule wins)")
+    rule = None
+    if args.rule:
+        # validate BEFORE any thread starts: a bogus rulestring raising
+        # mid-setup would leave the event consumer joined-on-forever
+        from .models import LifeRule
+
+        try:
+            rule = LifeRule.from_rulestring(args.rule)
+        except ValueError as e:
+            parser.error(str(e))
 
     from . import Params, run
 
@@ -124,8 +146,16 @@ def main(argv=None) -> int:
         # the in-process engine can feed the visualiser per-cell flips; the
         # remote path (like the reference's distributed mode) cannot
         emit_flips = not args.noVis and broker is None
-        run(params, events, keypresses, broker=broker,
-            emit_flips=emit_flips, resume_from=args.resume)
+        import contextlib
+
+        trace_ctx = contextlib.nullcontext()
+        if args.trace:
+            from .utils.trace import trace
+
+            trace_ctx = trace(args.trace)
+        with trace_ctx:
+            run(params, events, keypresses, broker=broker, rule=rule,
+                emit_flips=emit_flips, resume_from=args.resume)
     finally:
         consumer.join()
         restore_tty()

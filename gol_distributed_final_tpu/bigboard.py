@@ -363,11 +363,16 @@ def main(argv=None) -> int:
                         help="seed from a PGM instead of the R-pentomino")
     parser.add_argument("-row-block", type=int, default=1024)
     parser.add_argument(
+        "-rule", default=None, metavar="B.../S...",
+        help="life-like rulestring (default Conway B3/S23)",
+    )
+    parser.add_argument(
         "-session", action="store_true", default=False,
         help="run through big_session: 2 s alive-count ticker, s/q/k/p "
              "keys on stdin (tty), events printed like the headless drain",
     )
     args = parser.parse_args(argv)
+    rule = LifeRule.from_rulestring(args.rule) if args.rule else CONWAY
     cells = None if args.in_path else r_pentomino(args.size)
     if args.session:
         import pathlib
@@ -388,7 +393,7 @@ def main(argv=None) -> int:
             out_path = pathlib.Path(args.out)
             result = big_session(
                 args.size, args.turns, cells=cells, in_path=args.in_path,
-                row_block=args.row_block, events=events,
+                rule=rule, row_block=args.row_block, events=events,
                 keypresses=keypresses, out_dir=out_path.parent,
             )
             conventional = (
@@ -406,7 +411,8 @@ def main(argv=None) -> int:
         return 0
     alive = run_big_board(
         args.size, args.turns, args.out,
-        cells=cells, in_path=args.in_path, row_block=args.row_block,
+        cells=cells, in_path=args.in_path, rule=rule,
+        row_block=args.row_block,
     )
     print(f"alive {alive}")
     return 0

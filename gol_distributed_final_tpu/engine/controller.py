@@ -299,9 +299,28 @@ def run(
         world = ckpt_world if resume_from is not None else read_board(params, images_dir)
         ticker = _Ticker(params, events, keypresses, broker, out_dir, tick_seconds)
         ticker.start()
-        # the checkpoint's rule rides along only on a resume: brokers are
-        # duck-typed and pre-resume fakes/backends need not know the kwarg
-        extra = {} if ckpt_rule is None else {"rule": ckpt_rule}
+        # a non-default rule rides along to the broker — from a resumed
+        # checkpoint or an explicit session rule — so a remote backend
+        # cannot silently evolve the wrong family. Only passed when set:
+        # brokers are duck-typed and plain-Conway fakes need not know the
+        # kwarg
+        if (
+            rule is not None
+            and ckpt_rule is not None
+            and rule.rulestring != ckpt_rule.rulestring
+        ):
+            raise ValueError(
+                f"rule={rule.rulestring} conflicts with the checkpoint's "
+                f"{ckpt_rule.rulestring}: a resumed board must continue "
+                "under the rule it was evolved with"
+            )
+        wire_rule = ckpt_rule if ckpt_rule is not None else rule
+        if (
+            wire_rule is None
+            and engine_config.rule.rulestring != CONWAY.rulestring
+        ):
+            wire_rule = engine_config.rule
+        extra = {} if wire_rule is None else {"rule": wire_rule}
         result = broker.run(
             params,
             world,

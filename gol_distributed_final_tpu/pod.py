@@ -467,6 +467,8 @@ def main(argv=None) -> int:
     parser.add_argument("-ck-every", type=int, default=0)
     parser.add_argument("-resume", action="store_true", default=False,
                         help="resume from -ck's per-rank shards")
+    parser.add_argument("-rule", default=None, metavar="B.../S...",
+                        help="life-like rulestring (default Conway B3/S23)")
     args = parser.parse_args(argv)
     # fail on argument mistakes BEFORE every host pays jax.distributed
     # initialisation, with messages that name the flags involved
@@ -474,6 +476,12 @@ def main(argv=None) -> int:
         parser.error("-resume needs -ck (the checkpoint base path)")
     if args.resume and args.in_path:
         parser.error("-resume restores the board from -ck; drop -in")
+    rule = CONWAY
+    if args.rule:
+        try:
+            rule = LifeRule.from_rulestring(args.rule)
+        except ValueError as e:
+            parser.error(str(e))
 
     multihost.initialize(
         args.coordinator, args.num_processes, args.process_id
@@ -498,6 +506,7 @@ def main(argv=None) -> int:
             mesh,
             in_path=args.in_path,
             cells=None if (args.in_path or args.resume) else r_pentomino(args.size),
+            rule=rule,
             row_block=args.row_block,
             events=events,
             keypresses=keypresses,

@@ -309,3 +309,47 @@ def test_auto_checkpoint_stamps_active_plane_rule(tmp_path):
     )
     _, turn, rule, _ = load_packed_checkpoint(ck)
     assert rule.rulestring == HIGHLIFE.rulestring and turn == 40
+
+
+def test_cli_rule_and_trace(tmp_path):
+    """`-rule B36/S23` evolves HighLife (PGM matches the numpy oracle)
+    and `-trace DIR` leaves a jax.profiler trace behind — the reference's
+    TestTrace role (trace_test.go:12-29) on the CLI."""
+    import os
+    import subprocess
+    import sys
+
+    import numpy as np
+
+    from oracle import vector_step
+    from gol_distributed_final_tpu.io.pgm import read_pgm
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO_ROOT))
+    (tmp_path / "images").mkdir()
+    rng = np.random.default_rng(21)
+    board = np.where(rng.random((64, 64)) < 0.3, 255, 0).astype(np.uint8)
+    (tmp_path / "images" / "64x64.pgm").write_bytes(
+        b"P5\n64 64\n255\n" + board.tobytes()
+    )
+    r = subprocess.run(
+        [sys.executable, "-m", "gol_distributed_final_tpu",
+         "-w", "64", "-h", "64", "-turns", "30", "-noVis",
+         "-rule", "B36/S23", "-trace", str(tmp_path / "tr")],
+        capture_output=True, text=True, timeout=240, env=env, cwd=tmp_path,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    got = read_pgm(tmp_path / "out" / "64x64x30.pgm")
+    want = board
+    for _ in range(30):
+        want = vector_step(want, birth=(3, 6), survive=(2, 3))
+    np.testing.assert_array_equal(got, want)
+    trace_files = list((tmp_path / "tr").rglob("*"))
+    assert any(f.is_file() for f in trace_files), "no trace written"
+
+    # -rule + -resume is rejected up front (the checkpoint's rule wins)
+    r2 = subprocess.run(
+        [sys.executable, "-m", "gol_distributed_final_tpu",
+         "-rule", "B36/S23", "-resume", "x.npz", "-noVis"],
+        capture_output=True, text=True, timeout=60, env=env, cwd=tmp_path,
+    )
+    assert r2.returncode != 0 and "conflicts" in r2.stderr

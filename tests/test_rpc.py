@@ -638,3 +638,36 @@ def test_broker_service_validates_resume_bounds(tpu_broker):
             )
     finally:
         client.close()
+
+
+def test_session_rule_reaches_remote_broker(tpu_broker, tmp_path):
+    """controller.run(rule=HIGHLIFE, broker=RemoteBroker) must evolve
+    HighLife ON THE SERVER — the rulestring rides the wire for explicit
+    session rules, not just resumed checkpoints."""
+    from oracle import vector_step
+
+    from gol_distributed_final_tpu.models import HIGHLIFE
+
+    address, _ = tpu_broker
+    p = Params(turns=30, image_width=64, image_height=64)
+    events = queue.Queue()
+    remote = RemoteBroker(address)
+    try:
+        result = run(
+            p,
+            events,
+            None,
+            broker=remote,
+            rule=HIGHLIFE,
+            images_dir=REPO_ROOT / "images",
+            out_dir=tmp_path / "out",
+            tick_seconds=3600,
+        )
+    finally:
+        remote.close()
+    import gol_distributed_final_tpu.io.pgm as pgm
+
+    want = pgm.read_board(p, REPO_ROOT / "images")
+    for _ in range(30):
+        want = vector_step(want, birth=(3, 6), survive=(2, 3))
+    np.testing.assert_array_equal(result.world, want)
