@@ -73,6 +73,21 @@ def seed_packed(size: int, cells: Cells, word_axis: int = 0):
     return jnp.asarray(packed.view(np.int32))
 
 
+def check_window(packed_shape, y0, x0, h, w, word_axis: int = 0) -> None:
+    """Validate a decode window against a packed board's geometry —
+    shared by the single-host and pod (pod.decode_window_sharded)
+    decoders so both raise identically on out-of-range requests."""
+    rows, cols = packed_shape
+    height = rows * WORD if word_axis == 0 else rows
+    width = cols if word_axis == 0 else cols * WORD
+    if h <= 0 or w <= 0:
+        raise ValueError(f"window extent {h}x{w} must be positive")
+    if not (0 <= y0 and y0 + h <= height and 0 <= x0 and x0 + w <= width):
+        raise ValueError(
+            f"window [{y0}:{y0 + h}, {x0}:{x0 + w}] outside {height}x{width}"
+        )
+
+
 def decode_window(
     state, y0: int, x0: int, h: int, w: int, word_axis: int = 0
 ) -> np.ndarray:
@@ -82,15 +97,7 @@ def decode_window(
     SDL window shows the whole board, sdl/window.go:22-104; at config-5
     sizes only a window can ever be shown). Only the word rows covering
     the window cross the packed->byte boundary."""
-    rows, cols = state.shape
-    height = rows * WORD if word_axis == 0 else rows
-    width = cols if word_axis == 0 else cols * WORD
-    if h <= 0 or w <= 0:
-        raise ValueError(f"window extent {h}x{w} must be positive")
-    if not (0 <= y0 and y0 + h <= height and 0 <= x0 and x0 + w <= width):
-        raise ValueError(
-            f"window [{y0}:{y0 + h}, {x0}:{x0 + w}] outside {height}x{width}"
-        )
+    check_window(state.shape, y0, x0, h, w, word_axis)
     if word_axis == 0:
         r0, r1 = y0 // WORD, -(-(y0 + h) // WORD)
         block = state[r0:r1, x0 : x0 + w]

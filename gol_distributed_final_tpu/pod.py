@@ -148,6 +148,46 @@ def load_packed_from_pgm_sharded(
     )
 
 
+def decode_window_sharded(
+    state, y0: int, x0: int, h: int, w: int, word_axis: int = 0
+) -> np.ndarray:
+    """The uint8 window ``[y0:y0+h, x0:x0+w]`` of a MESH-SHARDED packed
+    board, decoded collectively: the word rows covering the window are
+    gathered replicated (a window is KiB — the 4 GiB raster never forms),
+    unpacked, and sliced, so EVERY rank returns the same array.
+
+    Collective — all ranks must call with the same arguments (e.g. from
+    the pod chunk gate, like the count). The single-host sibling is
+    ``bigboard.decode_window``; this is its pod-topology form, serving
+    the same role the reference's SDL window serves one-host
+    (sdl/window.go:22-104)."""
+    from jax.experimental import multihost_utils
+
+    from .bigboard import check_window, decode_window
+
+    if getattr(state, "is_fully_addressable", True):
+        return decode_window(state, y0, x0, h, w, word_axis)
+
+    check_window(state.shape, y0, x0, h, w, word_axis)
+    # slice BOTH axes down to the window's covering word block before the
+    # gather, so only KiB cross the hosts (decode_window does the same
+    # locally); process_allgather is the repo's cached replication helper
+    if word_axis == 0:
+        r0, r1 = y0 // WORD, -(-(y0 + h) // WORD)
+        block = state[r0:r1, x0 : x0 + w]
+    else:
+        c0, c1 = x0 // WORD, -(-(x0 + w) // WORD)
+        block = state[y0 : y0 + h, c0:c1]
+    gathered = np.asarray(multihost_utils.process_allgather(block, tiled=True))
+    from .ops.bitpack import unpack
+
+    if word_axis == 0:
+        rows_out = unpack(gathered, 0)
+        return rows_out[y0 - r0 * WORD : y0 - r0 * WORD + h]
+    cols_out = unpack(gathered, 1)
+    return cols_out[:, x0 - c0 * WORD : x0 - c0 * WORD + w]
+
+
 class _PodControl:
     """The rank-0-driven control gate installed as EngineConfig.chunk_hook.
 

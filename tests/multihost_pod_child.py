@@ -108,6 +108,19 @@ def main() -> int:
         max_chunk=4,
     )
     assert res2.turns_completed == turns
+
+    # pod-scale inspection: the collective window decode returns the same
+    # board region on EVERY rank, matching the streamed PGM on disk
+    from gol_distributed_final_tpu.io.sharded import read_shard
+    from gol_distributed_final_tpu.pod import decode_window_sharded
+
+    c = size // 2
+    state2 = res2._state  # the final mesh-sharded packed board
+    assert not state2.is_fully_addressable
+    win = decode_window_sharded(state2, c - 64, c - 64, 128, 128)
+    rows = read_shard(tmpdir / "out2" / f"{size}x{size}x{turns}.pgm", c - 64, c + 64)
+    np.testing.assert_array_equal(win, rows[:, c - 64 : c + 64])
+
     print(f"rank {proc_id} done", flush=True)
     return 0
 

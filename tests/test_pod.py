@@ -268,3 +268,20 @@ def test_pod_session_rejects_stale_resume(tmp_path):
             SIZE, 60, mesh, resume_from=ck, rule=HIGHLIFE,
             events=queue.Queue(),
         )
+
+
+def test_decode_window_sharded_single_host_fallback(tmp_path):
+    """On a fully-addressable state the pod window decode equals the
+    local one (the gather branch is exercised by the 2-process child,
+    tests/multihost_pod_child.py)."""
+    from gol_distributed_final_tpu.bigboard import decode_window
+    from gol_distributed_final_tpu.pod import decode_window_sharded
+
+    board = _random_board(9)
+    in_path = tmp_path / f"{SIZE}x{SIZE}.pgm"
+    _write_pgm(in_path, board)
+    mesh = make_mesh((2, 4))
+    state = load_packed_from_pgm_sharded(in_path, mesh)
+    got = decode_window_sharded(state, 32, 48, 64, 96)
+    np.testing.assert_array_equal(got, decode_window(state, 32, 48, 64, 96))
+    np.testing.assert_array_equal(got, board[32:96, 48:144])
