@@ -88,6 +88,20 @@ def check_window(packed_shape, y0, x0, h, w, word_axis: int = 0) -> None:
         )
 
 
+def window_word_bounds(
+    y0: int, x0: int, h: int, w: int, word_axis: int
+) -> tuple[int, int, int]:
+    """The covering word range along the PACKED axis for a cell window:
+    ``(a0, a1, off)`` — packed indices ``[a0:a1]`` cover the window, and
+    the window starts ``off`` cells into the unpacked block. Shared by the
+    single-host and pod decoders so their slice arithmetic cannot drift."""
+    if word_axis == 0:
+        a0, a1 = y0 // WORD, -(-(y0 + h) // WORD)
+        return a0, a1, y0 - a0 * WORD
+    a0, a1 = x0 // WORD, -(-(x0 + w) // WORD)
+    return a0, a1, x0 - a0 * WORD
+
+
 def decode_window(
     state, y0: int, x0: int, h: int, w: int, word_axis: int = 0
 ) -> np.ndarray:
@@ -98,15 +112,14 @@ def decode_window(
     sizes only a window can ever be shown). Only the word rows covering
     the window cross the packed->byte boundary."""
     check_window(state.shape, y0, x0, h, w, word_axis)
+    a0, a1, off = window_word_bounds(y0, x0, h, w, word_axis)
     if word_axis == 0:
-        r0, r1 = y0 // WORD, -(-(y0 + h) // WORD)
-        block = state[r0:r1, x0 : x0 + w]
+        block = state[a0:a1, x0 : x0 + w]
         rows_out = np.asarray(unpack_device(block, 0))
-        return rows_out[y0 - r0 * WORD : y0 - r0 * WORD + h]
-    c0, c1 = x0 // WORD, -(-(x0 + w) // WORD)
-    block = state[y0 : y0 + h, c0:c1]
+        return rows_out[off : off + h]
+    block = state[y0 : y0 + h, a0:a1]
     cols_out = np.asarray(unpack_device(block, 1))
-    return cols_out[:, x0 - c0 * WORD : x0 - c0 * WORD + w]
+    return cols_out[:, off : off + w]
 
 
 def stream_packed_to_pgm(path, state, word_axis: int = 0, row_block: int = 1024):

@@ -74,6 +74,33 @@ def _local_bit_step(block, *, rule: LifeRule, mesh_shape, word_axis: int):
     return out[1:-1, 1:-1]
 
 
+def _local_bit_step_wide(
+    block, *, rule: LifeRule, mesh_shape, word_axis: int, depth: int
+):
+    """``depth`` turns per halo exchange on the packed block (temporal
+    blocking — see halo._local_step_wide for the ring-invalidation
+    argument; here rings are WORDS on the packed axis, elements on the
+    other). On the packed axis a k-word halo every k turns ships the same
+    volume as one word every turn — the win is k-fold fewer collective
+    LATENCIES, the bound when a mesh axis crosses DCN. ``bit_step``'s own
+    cyclic rotates only contaminate the outermost ring each step, which
+    is exactly the ring invalidated anyway."""
+    nrows, ncols = mesh_shape
+    ext = _exchange(block, ROWS, nrows, dim=0, k=depth)
+    ext = _exchange(ext, COLS, ncols, dim=1, k=depth)
+    for _ in range(depth):  # static: unrolled at trace time
+        # slice the just-invalidated outer ring off immediately (instead
+        # of depth rings at the end): later steps run on strictly smaller
+        # arrays, and the final ext is already the block shape
+        ext = bit_step(
+            ext,
+            word_axis,
+            birth_mask=rule.birth_mask,
+            survive_mask=rule.survive_mask,
+        )[1:-1, 1:-1]
+    return ext
+
+
 def _local_bit_step_pallas(block, *, rule: LifeRule, mesh_shape, interpret):
     """One turn on a local block through the grid-tiled pallas kernel
     (word_axis=0 only).
@@ -142,6 +169,7 @@ def sharded_bit_step_n_fn(
     *,
     pallas_local: bool | None = None,
     interpret: bool | None = None,
+    halo_depth: int = 1,
 ) -> Callable:
     """A jitted ``(packed, n) -> packed`` over a P('rows','cols')-sharded
     int32 bitboard: n turns in ONE dispatch, the fori_loop (halo ppermutes
@@ -151,7 +179,21 @@ def sharded_bit_step_n_fn(
     grid-tiled pallas kernel (None = auto: on real TPU when the local
     block is past the VMEM gate where XLA spills; see
     ``_pallas_local_ok``). ``interpret`` forces pallas interpret mode —
-    the CPU-mesh test hook."""
+    the CPU-mesh test hook.
+
+    ``halo_depth=k`` exchanges k-deep halos and runs k turns locally per
+    exchange (``_local_bit_step_wide``) — k-fold fewer collective
+    latencies per turn, the DCN-scaling lever. XLA local step only: the
+    pallas tiled kernel computes exactly one turn per aligned ext, so
+    ``pallas_local=True`` with ``halo_depth>1`` raises (auto routing
+    simply stays on XLA)."""
+    if halo_depth < 1:
+        raise ValueError(f"halo_depth must be >= 1, got {halo_depth}")
+    if halo_depth > 1 and pallas_local:
+        raise ValueError(
+            "halo_depth > 1 requires the XLA local step (pallas computes "
+            "one turn per aligned ext); drop pallas_local=True"
+        )
     mesh_shape = (mesh.shape[ROWS], mesh.shape[COLS])
     if interpret is None:
         from ..ops.pallas_stencil import default_interpret
@@ -159,6 +201,13 @@ def sharded_bit_step_n_fn(
         interpret = default_interpret()
     local = functools.partial(
         _local_bit_step, rule=rule, mesh_shape=mesh_shape, word_axis=word_axis
+    )
+    wide = functools.partial(
+        _local_bit_step_wide,
+        rule=rule,
+        mesh_shape=mesh_shape,
+        word_axis=word_axis,
+        depth=halo_depth,
     )
     local_pallas = functools.partial(
         _local_bit_step_pallas,
@@ -173,6 +222,13 @@ def sharded_bit_step_n_fn(
         step = local_pallas if use_pallas else local
 
         def local_n(block):
+            if halo_depth > 1:
+                block = lax.fori_loop(
+                    0, n // halo_depth, lambda _, b: wide(b), block
+                )
+                for _ in range(n % halo_depth):  # static remainder
+                    block = step(block)
+                return block
             return lax.fori_loop(0, n, lambda _, b: step(b), block)
 
         sharded = jax.shard_map(
@@ -195,8 +251,18 @@ def sharded_bit_step_n_fn(
             packed.shape[0] // mesh_shape[0],
             packed.shape[1] // mesh_shape[1],
         )
+        if halo_depth > min(block_shape):
+            raise ValueError(
+                f"halo_depth {halo_depth} exceeds the local block "
+                f"{block_shape}: a halo can only come from the adjacent "
+                "device"
+            )
         if pallas_local is None:
-            use_pallas = _pallas_local_ok(block_shape, word_axis) and not interpret
+            use_pallas = (
+                halo_depth == 1
+                and _pallas_local_ok(block_shape, word_axis)
+                and not interpret
+            )
         else:
             use_pallas = bool(pallas_local)
             if use_pallas and word_axis != 0:
@@ -222,11 +288,20 @@ class ShardedBitPlane:
     device-side pack/unpack placed on the mesh; alive_count is a sharded
     popcount reduction."""
 
-    def __init__(self, mesh: Mesh, rule: LifeRule = CONWAY, word_axis: int = 0):
+    def __init__(
+        self,
+        mesh: Mesh,
+        rule: LifeRule = CONWAY,
+        word_axis: int = 0,
+        halo_depth: int = 1,
+    ):
         self.mesh = mesh
         self.rule = rule
         self.word_axis = word_axis
-        self._step_n = sharded_bit_step_n_fn(mesh, rule, word_axis)
+        self.halo_depth = halo_depth
+        self._step_n = sharded_bit_step_n_fn(
+            mesh, rule, word_axis, halo_depth=halo_depth
+        )
         packed_shd = packed_sharding(mesh)
         board_shd = NamedSharding(mesh, P(ROWS, COLS))
         self._encode = jax.jit(

@@ -41,12 +41,19 @@ def _ring_perm(n: int, direction: int) -> list[tuple[int, int]]:
     return [(i, (i + direction) % n) for i in range(n)]
 
 
-def _exchange(block, axis_name: str, n: int, dim: int, pad: int = 0):
-    """Prepend/append wrap-around halo slices of thickness 1 along ``dim``,
-    exchanged with ring neighbours on ``axis_name``.
+def _exchange(block, axis_name: str, n: int, dim: int, pad: int = 0, k: int = 1):
+    """Prepend/append wrap-around halo slices of thickness ``k`` along
+    ``dim``, exchanged with ring neighbours on ``axis_name``.
 
     With a single device on the axis the halo is local wrap — the same
     concat, no communication.
+
+    ``k > 1`` is the WIDE-halo form (temporal blocking): a k-deep halo
+    lets the caller run k turns locally before the next exchange, cutting
+    the number of collective latencies per turn by k at identical traffic
+    volume (k slices every k turns) — the lever that matters when the
+    mesh axis crosses DCN, where per-collective latency, not bandwidth,
+    bounds scaling.
 
     ``pad`` adds that many ZERO slices outside each halo, fused into the
     same concatenate: the pallas local step (parallel/bit_halo.py) needs a
@@ -54,10 +61,14 @@ def _exchange(block, axis_name: str, n: int, dim: int, pad: int = 0):
     separate jnp.pad would cost a full extra array materialisation
     (~50 us/turn measured at 16384^2).
     """
+    if k < 1 or k > block.shape[dim]:
+        raise ValueError(
+            f"halo thickness {k} outside [1, local dim {block.shape[dim]}]"
+        )
     if dim == 0:
-        first, last = block[:1], block[-1:]
+        first, last = block[:k], block[-k:]
     else:
-        first, last = block[:, :1], block[:, -1:]
+        first, last = block[:, :k], block[:, -k:]
     if n == 1:
         before, after = last, first
     else:
@@ -86,6 +97,32 @@ def _local_step(block, *, rule: LifeRule, mesh_shape: tuple[int, int]):
     )
 
 
+def _local_step_wide(block, *, rule: LifeRule, mesh_shape, depth: int):
+    """``depth`` turns per halo exchange (temporal blocking): exchange a
+    depth-deep halo once, then step the extended block ``depth`` times
+    locally — each step invalidates one more outer ring, and exactly the
+    ``depth`` garbage rings are sliced away at the end. Collective count
+    per turn drops ``depth``-fold at identical traffic volume; the price
+    is redundant compute on the shrinking halo rings (O(depth * perimeter)
+    cells per exchange)."""
+    nrows, ncols = mesh_shape
+    ext = _exchange(block, ROWS, nrows, dim=0, k=depth)  # (h+2d, w)
+    ext = _exchange(ext, COLS, ncols, dim=1, k=depth)  # (h+2d, w+2d)
+    for _ in range(depth):  # static: unrolled at trace time
+        # shrinking form: each step consumes one halo ring — the ext IS
+        # the (interior+2)-window counts_from_extended expects, so no
+        # self-wrap concat and no final slice, and later steps run on
+        # strictly smaller arrays; after `depth` steps ext is back to the
+        # original block shape
+        h, w = ext.shape[0] - 2, ext.shape[1] - 2
+        counts = counts_from_extended(ext, h, w)
+        ext = apply_rule(
+            ext[1:-1, 1:-1], counts,
+            birth_mask=rule.birth_mask, survive_mask=rule.survive_mask,
+        )
+    return ext
+
+
 def sharded_step_fn(mesh: Mesh, rule: LifeRule = CONWAY) -> Callable:
     """A jitted ``board -> board`` over a globally-sharded ``uint8[H, W]``.
 
@@ -101,7 +138,9 @@ def sharded_step_fn(mesh: Mesh, rule: LifeRule = CONWAY) -> Callable:
     return jax.jit(sharded, in_shardings=sharding, out_shardings=sharding)
 
 
-def sharded_step_n_fn(mesh: Mesh, rule: LifeRule = CONWAY) -> Callable:
+def sharded_step_n_fn(
+    mesh: Mesh, rule: LifeRule = CONWAY, *, halo_depth: int = 1
+) -> Callable:
     """A jitted ``(board, n) -> board`` running ``n`` turns in ONE dispatch.
 
     The ``lax.fori_loop`` lives *inside* shard_map, so the whole multi-turn
@@ -109,14 +148,30 @@ def sharded_step_n_fn(mesh: Mesh, rule: LifeRule = CONWAY) -> Callable:
     per device: the per-turn synchronisation the reference implements as a
     host-side gather barrier (broker/broker.go:154-156) is just the
     dataflow dependency between collective and stencil.
+
+    ``halo_depth=k`` exchanges k-deep halos and runs k turns per exchange
+    (see ``_local_step_wide``) — the DCN-latency lever for multi-host
+    meshes. Turn counts not divisible by k finish with single-turn steps.
     """
+    if halo_depth < 1:
+        raise ValueError(f"halo_depth must be >= 1, got {halo_depth}")
     mesh_shape = (mesh.shape[ROWS], mesh.shape[COLS])
     local = functools.partial(_local_step, rule=rule, mesh_shape=mesh_shape)
+    wide = functools.partial(
+        _local_step_wide, rule=rule, mesh_shape=mesh_shape, depth=halo_depth
+    )
     sharding = board_sharding(mesh)
 
     @functools.lru_cache(maxsize=None)
     def _compiled(n: int):
         def local_n(block):
+            if halo_depth > 1:
+                block = lax.fori_loop(
+                    0, n // halo_depth, lambda _, b: wide(b), block
+                )
+                for _ in range(n % halo_depth):  # static remainder
+                    block = local(block)
+                return block
             return lax.fori_loop(0, n, lambda _, b: local(b), block)
 
         sharded = jax.shard_map(

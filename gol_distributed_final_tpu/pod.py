@@ -163,7 +163,7 @@ def decode_window_sharded(
     (sdl/window.go:22-104)."""
     from jax.experimental import multihost_utils
 
-    from .bigboard import check_window, decode_window
+    from .bigboard import check_window, decode_window, window_word_bounds
 
     if getattr(state, "is_fully_addressable", True):
         return decode_window(state, y0, x0, h, w, word_axis)
@@ -172,20 +172,17 @@ def decode_window_sharded(
     # slice BOTH axes down to the window's covering word block before the
     # gather, so only KiB cross the hosts (decode_window does the same
     # locally); process_allgather is the repo's cached replication helper
+    a0, a1, off = window_word_bounds(y0, x0, h, w, word_axis)
     if word_axis == 0:
-        r0, r1 = y0 // WORD, -(-(y0 + h) // WORD)
-        block = state[r0:r1, x0 : x0 + w]
+        block = state[a0:a1, x0 : x0 + w]
     else:
-        c0, c1 = x0 // WORD, -(-(x0 + w) // WORD)
-        block = state[y0 : y0 + h, c0:c1]
+        block = state[y0 : y0 + h, a0:a1]
     gathered = np.asarray(multihost_utils.process_allgather(block, tiled=True))
     from .ops.bitpack import unpack
 
     if word_axis == 0:
-        rows_out = unpack(gathered, 0)
-        return rows_out[y0 - r0 * WORD : y0 - r0 * WORD + h]
-    cols_out = unpack(gathered, 1)
-    return cols_out[:, x0 - c0 * WORD : x0 - c0 * WORD + w]
+        return unpack(gathered, 0)[off : off + h]
+    return unpack(gathered, 1)[:, off : off + w]
 
 
 class _PodControl:
@@ -333,6 +330,7 @@ def pod_session(
     resume_from=None,
     min_chunk: int = 16,
     max_chunk: int = 256,
+    halo_depth: int = 1,
 ):
     """The full reference session surface over a multi-host packed board.
 
@@ -418,7 +416,7 @@ def pod_session(
         else:
             raise ValueError("one of resume_from / in_path / cells is required")
 
-        plane = ShardedBitPlane(mesh, rule, word_axis)
+        plane = ShardedBitPlane(mesh, rule, word_axis, halo_depth=halo_depth)
         control = _PodControl(
             params,
             events,
@@ -509,6 +507,11 @@ def main(argv=None) -> int:
                         help="resume from -ck's per-rank shards")
     parser.add_argument("-rule", default=None, metavar="B.../S...",
                         help="life-like rulestring (default Conway B3/S23)")
+    parser.add_argument(
+        "-halo-depth", dest="halo_depth", type=int, default=1,
+        help="turns per halo exchange (wide halos: k-fold fewer collective "
+             "latencies per turn — raise on DCN-crossed meshes)",
+    )
     args = parser.parse_args(argv)
     # fail on argument mistakes BEFORE every host pays jax.distributed
     # initialisation, with messages that name the flags involved
@@ -522,6 +525,8 @@ def main(argv=None) -> int:
             rule = LifeRule.from_rulestring(args.rule)
         except ValueError as e:
             parser.error(str(e))
+    if args.halo_depth < 1:
+        parser.error(f"-halo-depth must be >= 1, got {args.halo_depth}")
 
     multihost.initialize(
         args.coordinator, args.num_processes, args.process_id
@@ -554,6 +559,7 @@ def main(argv=None) -> int:
             checkpoint_every=args.ck_every,
             checkpoint_path=args.ck,
             resume_from=args.ck if args.resume else None,
+            halo_depth=args.halo_depth,
         )
     finally:
         if consumer is not None:
