@@ -40,7 +40,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models import CONWAY, LifeRule
 from ..ops.bitpack import WORD, bit_step, pack_device, unpack_device
-from .halo import _exchange
+from .halo import _exchange, check_halo_depth, wide_loop
 from .mesh import COLS, ROWS
 
 
@@ -222,14 +222,7 @@ def sharded_bit_step_n_fn(
         step = local_pallas if use_pallas else local
 
         def local_n(block):
-            if halo_depth > 1:
-                block = lax.fori_loop(
-                    0, n // halo_depth, lambda _, b: wide(b), block
-                )
-                for _ in range(n % halo_depth):  # static remainder
-                    block = step(block)
-                return block
-            return lax.fori_loop(0, n, lambda _, b: step(b), block)
+            return wide_loop(block, n, halo_depth, step, wide)
 
         sharded = jax.shard_map(
             local_n,
@@ -251,12 +244,7 @@ def sharded_bit_step_n_fn(
             packed.shape[0] // mesh_shape[0],
             packed.shape[1] // mesh_shape[1],
         )
-        if halo_depth > min(block_shape):
-            raise ValueError(
-                f"halo_depth {halo_depth} exceeds the local block "
-                f"{block_shape}: a halo can only come from the adjacent "
-                "device"
-            )
+        check_halo_depth(halo_depth, block_shape)
         if pallas_local is None:
             use_pallas = (
                 halo_depth == 1
@@ -351,7 +339,10 @@ class ShardedBitPlane:
 
 
 def make_bit_plane(
-    mesh: Mesh, board_shape: tuple[int, int], rule: LifeRule = CONWAY
+    mesh: Mesh,
+    board_shape: tuple[int, int],
+    rule: LifeRule = CONWAY,
+    halo_depth: int = 1,
 ) -> Optional[ShardedBitPlane]:
     """A ShardedBitPlane for this board/mesh if a packed layout divides,
     else None (caller falls back to the byte halo plane)."""
@@ -359,4 +350,4 @@ def make_bit_plane(
     word_axis = choose_bit_layout(board_shape, mesh_shape)
     if word_axis is None:
         return None
-    return ShardedBitPlane(mesh, rule, word_axis)
+    return ShardedBitPlane(mesh, rule, word_axis, halo_depth=halo_depth)

@@ -97,6 +97,31 @@ def _local_step(block, *, rule: LifeRule, mesh_shape: tuple[int, int]):
     )
 
 
+def wide_loop(block, n: int, depth: int, step, wide):
+    """``n`` turns as ``n // depth`` wide iterations (``depth`` turns per
+    halo exchange) plus a STATIC single-turn remainder — the one chunking
+    arithmetic both data planes share, so the byte and packed evolutions
+    cannot drift."""
+    if depth > 1:
+        block = lax.fori_loop(0, n // depth, lambda _, b: wide(b), block)
+        for _ in range(n % depth):
+            block = step(block)
+        return block
+    return lax.fori_loop(0, n, lambda _, b: step(b), block)
+
+
+def check_halo_depth(depth: int, block_shape) -> None:
+    """A halo can only come from the adjacent device: depth is bounded by
+    the local block's smaller dimension. Shared by both planes so the
+    error names the knob the user actually set."""
+    if depth > min(block_shape):
+        raise ValueError(
+            f"halo_depth {depth} exceeds the local block "
+            f"{tuple(block_shape)}: a halo can only come from the "
+            "adjacent device"
+        )
+
+
 def _local_step_wide(block, *, rule: LifeRule, mesh_shape, depth: int):
     """``depth`` turns per halo exchange (temporal blocking): exchange a
     depth-deep halo once, then step the extended block ``depth`` times
@@ -165,14 +190,7 @@ def sharded_step_n_fn(
     @functools.lru_cache(maxsize=None)
     def _compiled(n: int):
         def local_n(block):
-            if halo_depth > 1:
-                block = lax.fori_loop(
-                    0, n // halo_depth, lambda _, b: wide(b), block
-                )
-                for _ in range(n % halo_depth):  # static remainder
-                    block = local(block)
-                return block
-            return lax.fori_loop(0, n, lambda _, b: local(b), block)
+            return wide_loop(block, n, halo_depth, local, wide)
 
         sharded = jax.shard_map(
             local_n, mesh=mesh, in_specs=P(ROWS, COLS), out_specs=P(ROWS, COLS)
@@ -180,6 +198,10 @@ def sharded_step_n_fn(
         return jax.jit(sharded, in_shardings=sharding, out_shardings=sharding)
 
     def step_n(board, n):
+        check_halo_depth(
+            halo_depth,
+            (board.shape[0] // mesh_shape[0], board.shape[1] // mesh_shape[1]),
+        )
         return _compiled(int(n))(board)
 
     return step_n

@@ -359,7 +359,7 @@ def pod_session(
         StateChange,
     )
     from .params import Params
-    from .parallel.bit_halo import ShardedBitPlane, choose_bit_layout, packed_sharding
+    from .parallel.bit_halo import make_bit_plane, packed_sharding
     from .parallel.mesh import COLS, ROWS
     from .parallel.multihost import host_row_range
 
@@ -368,12 +368,13 @@ def pod_session(
         events = queue_mod.Queue()
     try:
         mesh_shape = (mesh.shape[ROWS], mesh.shape[COLS])
-        word_axis = choose_bit_layout((size, size), mesh_shape)
-        if word_axis is None:
+        plane = make_bit_plane(mesh, (size, size), rule, halo_depth=halo_depth)
+        if plane is None:
             raise ValueError(
                 f"no packed layout of {size}x{size} divides over mesh "
                 f"{mesh_shape}"
             )
+        word_axis = plane.word_axis
         params = Params(turns=turns, image_width=size, image_height=size)
         out_file = pathlib.Path(out_dir) / f"{params.output_filename}.pgm"
 
@@ -416,7 +417,6 @@ def pod_session(
         else:
             raise ValueError("one of resume_from / in_path / cells is required")
 
-        plane = ShardedBitPlane(mesh, rule, word_axis, halo_depth=halo_depth)
         control = _PodControl(
             params,
             events,

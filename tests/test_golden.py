@@ -69,3 +69,36 @@ def test_event_sequence_tail(tmp_path):
     assert isinstance(tail[2], StateChange)
     assert tail[2].new_state == State.QUITTING
     assert str(tail[2]) == "Quitting"
+
+
+def test_non_square_session_true_hxw(tmp_path):
+    """True H x W semantics through the FULL session, W != H. The
+    reference conflates width/height in several allocations and in the
+    kernel's wrap logic (SURVEY.md §5 quirks — invisible on its square
+    inputs); here a 96x64 board must evolve correctly end to end, with
+    the reference's <W>x<H> filename conventions."""
+    from oracle import vector_step
+
+    from gol_distributed_final_tpu import Params, run
+
+    H, W, TURNS = 64, 96, 20
+    rng = np.random.default_rng(41)
+    board = np.where(rng.random((H, W)) < 0.3, 255, 0).astype(np.uint8)
+    (tmp_path / "images").mkdir()
+    (tmp_path / "images" / f"{W}x{H}.pgm").write_bytes(
+        b"P5\n%d %d\n255\n" % (W, H) + board.tobytes()
+    )
+    p = Params(turns=TURNS, image_width=W, image_height=H)
+    result = run(
+        p,
+        queue.Queue(),
+        images_dir=tmp_path / "images",
+        out_dir=tmp_path / "out",
+        tick_seconds=3600,
+    )
+    want = board
+    for _ in range(TURNS):
+        want = vector_step(want)
+    np.testing.assert_array_equal(result.world, want)
+    got = read_pgm(tmp_path / "out" / f"{W}x{H}x{TURNS}.pgm")
+    np.testing.assert_array_equal(got, want)
