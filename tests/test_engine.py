@@ -463,3 +463,27 @@ def test_multihost_checkpoint_without_packed_plane_raises():
         )
     # the engine is reusable after the rejected run
     assert not engine._running
+
+
+def test_chunk_hook_exception_leaves_engine_reusable():
+    """A failing chunk gate (e.g. a pod broadcast whose peer died) must
+    propagate — the caller decides recovery — but the engine must come
+    back reusable: _running cleared, a fresh run accepted."""
+    import pytest
+
+    calls = {"n": 0}
+
+    def bad_hook(engine, state, turn):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise ConnectionError("peer rank vanished")
+
+    cfg = EngineConfig(min_chunk=2, max_chunk=2, chunk_hook=bad_hook)
+    engine = Engine(cfg)
+    p = Params(turns=100, image_width=16, image_height=16)
+    with pytest.raises(ConnectionError):
+        engine.run(p, small_board(13))
+    assert not engine._running
+    # the hook keeps firing on the rerun (fresh call counter from 3 on)
+    res = engine.run(Params(turns=4, image_width=16, image_height=16), small_board(13))
+    assert res.turns_completed == 4
