@@ -484,6 +484,8 @@ def test_chunk_hook_exception_leaves_engine_reusable():
     with pytest.raises(ConnectionError):
         engine.run(p, small_board(13))
     assert not engine._running
-    # the hook keeps firing on the rerun (fresh call counter from 3 on)
+    # the hook keeps firing on the rerun (fresh call counter from 3 on):
+    # turns=4 with chunk 2 gates twice, so the counter must reach 4
     res = engine.run(Params(turns=4, image_width=16, image_height=16), small_board(13))
     assert res.turns_completed == 4
+    assert calls["n"] == 4, "chunk_hook was disabled by the earlier failure"

@@ -285,3 +285,44 @@ def test_decode_window_sharded_single_host_fallback(tmp_path):
     got = decode_window_sharded(state, 32, 48, 64, 96)
     np.testing.assert_array_equal(got, decode_window(state, 32, 48, 64, 96))
     np.testing.assert_array_equal(got, board[32:96, 48:144])
+
+
+def test_pod_session_column_packed_layout(tmp_path):
+    """A geometry where only COLUMN packing divides (96^2 over an (8,1)
+    mesh: 96 % (32*8) != 0 but 96 % 8 == 0 and 96 % 32 == 0) must route
+    the whole session — seeding, evolution, streaming — through the
+    word_axis=1 layout and still land oracle-exact."""
+    from gol_distributed_final_tpu.parallel.bit_halo import choose_bit_layout
+
+    size, turns = 96, 12
+    board = _random_board(10, size)
+    in_path = tmp_path / f"{size}x{size}.pgm"
+    _write_pgm(in_path, board)
+    mesh = make_mesh((8, 1))
+    assert choose_bit_layout((size, size), (8, 1)) == 1  # the premise
+
+    res = pod_session(
+        size,
+        turns,
+        mesh,
+        in_path=in_path,
+        events=queue.Queue(),
+        tick_seconds=0.001,
+        out_dir=tmp_path / "out",
+        min_chunk=4,
+        max_chunk=4,
+    )
+    assert res.turns_completed == turns
+    want = _oracle(board, turns)
+    assert len(res.alive) == int(np.count_nonzero(want))
+    got = (tmp_path / "out" / f"{size}x{size}x{turns}.pgm").read_bytes()
+    assert got == b"P5\n%d %d\n255\n" % (size, size) + want.tobytes()
+
+
+def test_load_packed_from_pgm_sharded_rejects_indivisible(tmp_path):
+    board = np.zeros((48, 48), np.uint8)  # 48 % 32 != 0
+    in_path = tmp_path / "48x48.pgm"
+    _write_pgm(in_path, board)
+    mesh = make_mesh((2, 4))
+    with pytest.raises(ValueError, match="not divisible"):
+        load_packed_from_pgm_sharded(in_path, mesh)
