@@ -9,8 +9,10 @@ Two interchangeable data-plane backends behind the same RPC verbs:
   is the shard_map halo data plane.
 * ``workers``: reference-shaped distribution — row strips scattered to
   remote worker processes over RPC and gathered per turn
-  (broker/broker.go:135-224), preserved for contract parity. Strips are
-  sent with 2 halo rows (O(strip) wire cost) instead of the full board.
+  (broker/broker.go:135-224), preserved for contract parity. By default
+  strips are sent with 2 halo rows (O(strip) wire cost); ``-wire full``
+  selects the reference-EXACT behavior of shipping the whole board to
+  every worker (broker/broker.go:144).
 
 Control semantics preserved: Run blocks and resets state; Pause toggles;
 Quit breaks the loop but keeps the process alive for a reattaching
@@ -115,9 +117,19 @@ class TpuBackend:
 
 class WorkersBackend:
     """Reference-shaped scatter/gather over remote workers
-    (broker/broker.go:62-234), with haloed strips on the wire."""
+    (broker/broker.go:62-234).
 
-    def __init__(self, worker_addresses: list[str]):
+    ``wire`` picks what a scatter ships: ``"haloed"`` (default) sends each
+    worker its strip plus the two wrap halo rows — O(strip) bytes; ``"full"``
+    is the reference-EXACT wire behavior, the whole board to every worker
+    with [start_y, end_y) bounds (broker/broker.go:144 — O(H x W) bytes per
+    worker per turn, the scalability limit README.md:204 points at,
+    preserved for contract archaeology)."""
+
+    def __init__(self, worker_addresses: list[str], wire: str = "haloed"):
+        if wire not in ("haloed", "full"):
+            raise ValueError(f"wire must be 'haloed' or 'full', got {wire!r}")
+        self._wire = wire
         self.clients: list[RpcClient] = []
         for addr in worker_addresses:
             try:
@@ -199,10 +211,17 @@ class WorkersBackend:
         import concurrent.futures
 
         def scatter(client, world, s, e):
-            rows = np.arange(s - 1, e + 1) % h
-            res = client.call(
-                Methods.WORKER_UPDATE, Request(world=world[rows], start_y=-1)
-            )
+            if self._wire == "full":
+                # reference-exact: ship the whole board, worker slices
+                res = client.call(
+                    Methods.WORKER_UPDATE,
+                    Request(world=world, start_y=s, end_y=e),
+                )
+            else:
+                rows = np.arange(s - 1, e + 1) % h
+                res = client.call(
+                    Methods.WORKER_UPDATE, Request(world=world[rows], start_y=-1)
+                )
             return res.work_slice
 
         active = list(self.clients)
@@ -390,10 +409,11 @@ def serve(
     backend: str = "tpu",
     worker_addresses: list[str] | None = None,
     host: str = "127.0.0.1",
+    wire: str = "haloed",
 ) -> tuple[RpcServer, BrokerService]:
     server = RpcServer(host=host, port=port)
     impl = (
-        WorkersBackend(worker_addresses or [])
+        WorkersBackend(worker_addresses or [], wire=wire)
         if backend == "workers"
         else TpuBackend()
     )
@@ -422,9 +442,17 @@ def main(argv=None) -> None:
         "-host", default="127.0.0.1",
         help="bind address; 0.0.0.0 opts into external exposure",
     )
+    parser.add_argument(
+        "-wire", choices=("haloed", "full"), default="haloed",
+        help="workers-backend scatter payload: haloed strips (O(strip) "
+             "bytes, default) or the reference-exact full board "
+             "(broker/broker.go:144)",
+    )
     args = parser.parse_args(argv)
     addresses = [a for a in args.workers.split(",") if a]
-    server, service = serve(args.port, args.backend, addresses, host=args.host)
+    server, service = serve(
+        args.port, args.backend, addresses, host=args.host, wire=args.wire
+    )
     print(f"broker listening on :{server.port} (backend={args.backend})", flush=True)
     service.quit_event.wait()
 

@@ -671,3 +671,41 @@ def test_session_rule_reaches_remote_broker(tpu_broker, tmp_path):
     for _ in range(30):
         want = vector_step(want, birth=(3, 6), survive=(2, 3))
     np.testing.assert_array_equal(result.world, want)
+
+
+def test_full_board_wire_mode_golden(tmp_path):
+    """The reference-EXACT wire behavior (-wire full: whole board to every
+    worker, [start_y, end_y) bounds, broker/broker.go:144) against real
+    worker subprocesses, landing on the turn-100 golden."""
+    from gol_distributed_final_tpu.rpc.broker import WorkersBackend
+
+    workers = [
+        _spawn("gol_distributed_final_tpu.rpc.worker", "-port", "0")
+        for _ in range(2)
+    ]
+    try:
+        ports = [_wait_listening(w) for w in workers]
+        backend = WorkersBackend(
+            [f"127.0.0.1:{p}" for p in ports], wire="full"
+        )
+        import gol_distributed_final_tpu.io.pgm as pgm
+
+        p = Params(turns=100, threads=2, image_width=16, image_height=16)
+        board = pgm.read_board(p, REPO_ROOT / "images")
+        result = backend.run(
+            Request(
+                world=board, turns=100, threads=2,
+                image_width=16, image_height=16,
+            )
+        )
+        from gol_distributed_final_tpu.ops import alive_cells
+
+        expected = read_alive_cells(
+            REPO_ROOT / "check" / "images" / "16x16x100.pgm"
+        )
+        assert_equal_board(alive_cells(result.world), expected, 16, 16)
+    finally:
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+            w.wait()
