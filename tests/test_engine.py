@@ -489,3 +489,76 @@ def test_chunk_hook_exception_leaves_engine_reusable():
     res = engine.run(Params(turns=4, image_width=16, image_height=16), small_board(13))
     assert res.turns_completed == 4
     assert calls["n"] == 4, "chunk_hook was disabled by the earlier failure"
+
+
+def test_control_plane_soak_random_keys(tmp_path):
+    """Monkey-test the session control plane: a random p/s/p/... key
+    stream (seeded, ending in 'q') drives a long 64^2 session while the
+    2-tick invariants are checked against the ONE-dispatch per-turn
+    history oracle (bitpack.alive_history): every AliveCellsCount must be
+    exact for its reported turn, whatever interleaving of pauses,
+    snapshots, and chunk commits produced it; the final board must equal
+    the history's state at turns_completed."""
+    import random
+
+    from gol_distributed_final_tpu.ops import bitpack
+
+    board = read_pgm(REPO_ROOT / "images" / "64x64.pgm")
+    packed = bitpack.pack(board, 0)
+    N = 200_000
+    history = np.asarray(bitpack.alive_history(packed, N))  # counts, turn 1..N
+
+    events, keys = queue.Queue(), queue.Queue()
+    rng = random.Random(7)
+
+    def feeder():
+        pauses = 0
+        for _ in range(12):
+            key = rng.choice(["p", "s", "p"])
+            pauses += key == "p"
+            keys.put(key)
+            time.sleep(0.08)
+        if pauses % 2:  # ensure 'q' lands on a RUNNING session
+            keys.put("p")
+        keys.put("q")
+
+    t = threading.Thread(target=feeder)
+    t.start()
+    result = run(
+        Params(turns=N, image_width=64, image_height=64),
+        events,
+        keys,
+        images_dir=REPO_ROOT / "images",
+        out_dir=tmp_path / "out",
+        tick_seconds=0.03,
+    )
+    t.join()
+
+    initial_alive = int(np.count_nonzero(board))
+    collected = []
+    while True:
+        ev = events.get_nowait()
+        if ev is CLOSED:
+            break
+        collected.append(ev)
+    ticks = [e for e in collected if isinstance(e, AliveCellsCount)]
+    assert ticks, "soak produced no tick events"
+    for e in ticks:
+        want = (
+            initial_alive
+            if e.completed_turns == 0
+            else int(history[e.completed_turns - 1])
+        )
+        assert e.cells_count == want, (
+            f"turn {e.completed_turns}: {e.cells_count} != {want}"
+        )
+    finals = [e for e in collected if isinstance(e, FinalTurnComplete)]
+    assert len(finals) == 1
+    done = result.turns_completed
+    assert 0 < done <= N
+    assert len(finals[0].alive) == int(history[done - 1])
+    # the final world is exactly the history state at that turn
+    want_board = np.asarray(
+        bitpack.unpack(np.asarray(bitpack.bit_step_n(packed, done, 0)), 0)
+    )
+    np.testing.assert_array_equal(result.world, want_board)
