@@ -95,7 +95,10 @@ def main() -> int:
         assert int(data["turn"]) == 16, int(data["turn"])
         assert int(data["num_processes"]) == num_procs
 
-    # phase 2: resume from turn 16 in a fresh engine; byte-identical end
+    # phase 2: resume from turn 16 in a fresh engine — WITH wide halos
+    # (halo_depth=2: two turns per exchange, the ppermutes crossing the
+    # process boundary carry 2-deep halos), so resume x temporal blocking
+    # is proven cross-host; the end must still be byte-identical
     res2 = pod_session(
         size,
         turns,
@@ -106,6 +109,7 @@ def main() -> int:
         out_dir=tmpdir / "out2",
         min_chunk=4,
         max_chunk=4,
+        halo_depth=2,
     )
     assert res2.turns_completed == turns
 
