@@ -101,9 +101,11 @@ def _local_bit_step_wide(
     return ext
 
 
-def _local_bit_step_pallas(block, *, rule: LifeRule, mesh_shape, interpret):
-    """One turn on a local block through the grid-tiled pallas kernel
-    (word_axis=0 only).
+def _local_bit_step_pallas(
+    block, *, rule: LifeRule, mesh_shape, interpret, depth: int = 1
+):
+    """``depth`` turns on a local block through the grid-tiled pallas
+    kernel (word_axis=0 only).
 
     Beyond the whole-board VMEM gate, the XLA ``bit_step`` spills its
     ~10 bit-plane temporaries to HBM — ~5x slower per device at 16384^2
@@ -113,22 +115,50 @@ def _local_bit_step_pallas(block, *, rule: LifeRule, mesh_shape, interpret):
     kernel.
 
     The kernel needs a sublane/lane-ALIGNED extended block, but only the
-    innermost halo word ever feeds the kept interior (a single turn reads
-    words +-1), so the exchange ships the same thickness-1 halos as the
-    XLA path and zero-pads locally — fused into the halo concats — out to
-    the (h+16, w+256) tile-aligned shape: alignment costs no extra ICI
-    traffic and no extra materialisation. The padded ring and the torus
-    wrap of the kernel only contaminate outputs that are sliced away."""
+    innermost ``depth`` halo words ever feed the kept interior (turn t
+    reads words +-t away), so the exchange ships the same thickness-k
+    halos as the XLA wide path and zero-pads locally — fused into the
+    halo concats — out to the (h+16, w+256) tile-aligned shape: alignment
+    costs no extra ICI traffic and no extra materialisation.
+
+    The WIDE form (``depth > 1``, temporal blocking — VERDICT r4 item 1)
+    needs no shrinking ext and no new kernel: the ext shape is the SAME
+    fixed aligned shape for every depth (pad = tile − depth halo words),
+    and the kernel simply runs ``depth`` single-turn launches on it
+    (``_tiled_compiled(depth, …)``'s existing fori_loop). Validity is a
+    ring-creep argument: the zero padding and the kernel's own torus wrap
+    of the ext are wrong data at word-distance ≥ depth from the body, and
+    each turn advances the contamination exactly one word-ring inward —
+    after ``depth`` turns it has consumed the ``depth``-word halo and
+    stops AT the body boundary. Hence the hard bound
+    ``depth <= _SUBLANE`` (8): at depth 8 the rows pad is zero and the
+    ring-creep exactly meets the interior slice."""
     from ..ops.pallas_tiled import _LANE, _SUBLANE, _tiled_compiled
 
     nrows, ncols = mesh_shape
-    # pad = tile - (1 halo word): body lands at offset (_SUBLANE, _LANE)
-    ext = _exchange(block, ROWS, nrows, dim=0, pad=_SUBLANE - 1)
-    ext = _exchange(ext, COLS, ncols, dim=1, pad=_LANE - 1)
+    # pad = tile - (depth halo words): body lands at offset (_SUBLANE, _LANE)
+    ext = _exchange(block, ROWS, nrows, dim=0, k=depth, pad=_SUBLANE - depth)
+    ext = _exchange(ext, COLS, ncols, dim=1, k=depth, pad=_LANE - depth)
     out = _tiled_compiled(
-        1, tuple(ext.shape), interpret, rule.birth_mask, rule.survive_mask
+        depth, tuple(ext.shape), interpret, rule.birth_mask, rule.survive_mask
     )(ext)
     return out[_SUBLANE:-_SUBLANE, _LANE:-_LANE]
+
+
+def _auto_use_pallas(
+    halo_depth: int, block_shape, word_axis: int, interpret: bool
+) -> bool:
+    """The ``pallas_local=None`` routing decision: the tiled kernel runs
+    when the local block is past the VMEM gate AND the halo depth fits
+    the aligned-ext form's sublane bound (8) — deeper halos silently stay
+    on the XLA local step, which has no depth ceiling."""
+    from ..ops.pallas_tiled import _SUBLANE
+
+    return (
+        halo_depth <= _SUBLANE
+        and _pallas_local_ok(block_shape, word_axis)
+        and not interpret
+    )
 
 
 def _pallas_local_ok(block_shape, word_axis: int) -> bool:
@@ -182,17 +212,25 @@ def sharded_bit_step_n_fn(
     the CPU-mesh test hook.
 
     ``halo_depth=k`` exchanges k-deep halos and runs k turns locally per
-    exchange (``_local_bit_step_wide``) — k-fold fewer collective
-    latencies per turn, the DCN-scaling lever. XLA local step only: the
-    pallas tiled kernel computes exactly one turn per aligned ext, so
-    ``pallas_local=True`` with ``halo_depth>1`` raises (auto routing
-    simply stays on XLA)."""
+    exchange (``_local_bit_step_wide`` / the wide form of
+    ``_local_bit_step_pallas``) — k-fold fewer collective latencies per
+    turn, the DCN-scaling lever. The two knobs COMPOSE: on the pallas
+    route the k-word halo rides the same fixed tile-aligned ext (pad
+    shrinks as the halo grows) and the kernel runs k launches on it, so
+    the config-5 topology gets the ~5x local kernel AND the k-fold
+    latency cut together. The pallas route bounds ``halo_depth`` at the
+    sublane tile (8) — past that the zero-ring contamination would creep
+    into the body — so ``pallas_local=True`` with ``halo_depth > 8``
+    raises (auto routing simply stays on XLA)."""
+    from ..ops.pallas_tiled import _SUBLANE as _PALLAS_MAX_DEPTH
+
     if halo_depth < 1:
         raise ValueError(f"halo_depth must be >= 1, got {halo_depth}")
-    if halo_depth > 1 and pallas_local:
+    if halo_depth > _PALLAS_MAX_DEPTH and pallas_local:
         raise ValueError(
-            "halo_depth > 1 requires the XLA local step (pallas computes "
-            "one turn per aligned ext); drop pallas_local=True"
+            f"halo_depth > {_PALLAS_MAX_DEPTH} exceeds the pallas aligned-"
+            "ext form (zero-ring contamination would reach the body); "
+            "drop pallas_local=True for deeper halos"
         )
     mesh_shape = (mesh.shape[ROWS], mesh.shape[COLS])
     if interpret is None:
@@ -215,14 +253,22 @@ def sharded_bit_step_n_fn(
         mesh_shape=mesh_shape,
         interpret=interpret,
     )
+    wide_pallas = functools.partial(
+        _local_bit_step_pallas,
+        rule=rule,
+        mesh_shape=mesh_shape,
+        interpret=interpret,
+        depth=halo_depth,
+    )
     sharding = packed_sharding(mesh)
 
     @functools.lru_cache(maxsize=None)
     def _compiled(n: int, use_pallas: bool):
         step = local_pallas if use_pallas else local
+        wide_fn = wide_pallas if use_pallas else wide
 
         def local_n(block):
-            return wide_loop(block, n, halo_depth, step, wide)
+            return wide_loop(block, n, halo_depth, step, wide_fn)
 
         sharded = jax.shard_map(
             local_n,
@@ -246,10 +292,8 @@ def sharded_bit_step_n_fn(
         )
         check_halo_depth(halo_depth, block_shape)
         if pallas_local is None:
-            use_pallas = (
-                halo_depth == 1
-                and _pallas_local_ok(block_shape, word_axis)
-                and not interpret
+            use_pallas = _auto_use_pallas(
+                halo_depth, block_shape, word_axis, interpret
             )
         else:
             use_pallas = bool(pallas_local)

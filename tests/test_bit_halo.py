@@ -319,8 +319,10 @@ class TestWideHalos:
         mesh = make_mesh((2, 4))
         with pytest.raises(ValueError, match="halo_depth"):
             sharded_bit_step_n_fn(mesh, halo_depth=0)
+        # the pallas aligned-ext form is bounded by the sublane tile (8):
+        # deeper halos must drop to the XLA local step
         with pytest.raises(ValueError, match="pallas"):
-            sharded_bit_step_n_fn(mesh, halo_depth=2, pallas_local=True)
+            sharded_bit_step_n_fn(mesh, halo_depth=9, pallas_local=True)
         # depth larger than the local block
         import jax
 
@@ -332,6 +334,58 @@ class TestWideHalos:
         step = sharded_bit_step_n_fn(mesh, halo_depth=3)  # local (2, 32)
         with pytest.raises(ValueError, match="exceeds the local block"):
             step(packed, 3)
+
+    @pytest.mark.parametrize("depth", [2, 3, 8])
+    def test_pallas_wide_matches_xla_wide(self, depth):
+        """Wide halos THROUGH the pallas tiled local step (VERDICT r4
+        item 1): the k-word halo rides the same fixed tile-aligned ext
+        and the kernel runs k launches on it. Must match both the XLA
+        wide path at the same depth and the depth-1 base path, across
+        block and torus boundaries, including the remainder path —
+        depth 8 is the exact ring-creep boundary (rows pad = 0)."""
+        from gol_distributed_final_tpu.parallel.bit_halo import (
+            packed_sharding,
+            sharded_bit_step_n_fn,
+        )
+
+        mesh = make_mesh((2, 4))
+        rng = np.random.default_rng(34)
+        board = np.where(rng.random((1024, 1024)) < 0.3, 255, 0).astype(np.uint8)
+        packed = jax.device_put(
+            bitpack.pack(board, 0), packed_sharding(mesh)
+        )  # [32, 1024] -> local blocks (16, 256): ext (32, 512) tiles cleanly
+        fast_wide = sharded_bit_step_n_fn(
+            mesh, pallas_local=True, interpret=True, halo_depth=depth
+        )
+        xla_wide = sharded_bit_step_n_fn(mesh, halo_depth=depth)
+        base = sharded_bit_step_n_fn(mesh)
+        for n in (depth, depth * 2 + 1):  # exact and remainder chunking
+            got = np.asarray(fast_wide(packed, n))
+            np.testing.assert_array_equal(
+                got, np.asarray(xla_wide(packed, n)),
+                err_msg=f"pallas-wide vs xla-wide, depth={depth} n={n}",
+            )
+            np.testing.assert_array_equal(
+                got, np.asarray(base(packed, n)),
+                err_msg=f"pallas-wide vs depth-1, depth={depth} n={n}",
+            )
+
+    def test_pallas_wide_auto_routing(self):
+        """Auto routing composes the knobs: a past-the-gate block with
+        halo_depth <= 8 still routes to pallas; depth > 8 falls back to
+        XLA instead of raising."""
+        from gol_distributed_final_tpu.parallel.bit_halo import (
+            _auto_use_pallas,
+            sharded_bit_step_n_fn,
+        )
+
+        past_gate = (128, 8192)  # 16384^2 over 4 chips: past the VMEM gate
+        assert _auto_use_pallas(1, past_gate, 0, interpret=False)
+        assert _auto_use_pallas(8, past_gate, 0, interpret=False)
+        # the sublane bound: depth 9 silently stays on XLA...
+        assert not _auto_use_pallas(9, past_gate, 0, interpret=False)
+        # ...and constructing with auto routing + deep halo must not raise
+        sharded_bit_step_n_fn(make_mesh((2, 4)), halo_depth=9)
 
     @pytest.mark.parametrize("depth", [2, 3])
     def test_wide_pod_session_golden(self, depth, tmp_path):
