@@ -29,7 +29,13 @@ from typing import Iterable, Sequence, Tuple
 import numpy as np
 
 from .models import CONWAY, LifeRule
-from .ops.bitpack import WORD, alive_count_packed, pack_device, unpack_device
+from .ops.bitpack import (
+    WORD,
+    alive_count_packed,
+    pack_device,
+    packed_shape,
+    unpack_device,
+)
 from .ops.plane import BitPlane
 
 Cells = Iterable[Tuple[int, int]]  # (x, y) pairs
@@ -42,27 +48,50 @@ def r_pentomino(size: int) -> list[tuple[int, int]]:
     return [(cx + dx, cy + dy) for dx, dy in offsets]
 
 
-def seed_packed(size: int, cells: Cells, word_axis: int = 0):
+def seed_packed(
+    size: int,
+    cells: Cells,
+    word_axis: int = 0,
+    row_range: tuple[int, int] | None = None,
+):
     """A packed device bitboard with only ``cells`` alive.
 
     Sparse construction: the dense byte board is never built — word
     indices and bit masks are computed host-side from the coordinate list
-    (O(len(cells))), then scattered into a device array of zeros."""
+    (O(len(cells))), then scattered into a device array of zeros.
+
+    ``row_range=(lo, hi)`` builds only the packed rows covering cell rows
+    [lo, hi) — the multi-host path, where each rank seeds only the rows
+    its devices own instead of a transient full-board allocation
+    (~size^2/8 bytes per rank at 65536^2; ADVICE r4). Cells outside the
+    range are skipped (after global-bounds validation). For
+    ``word_axis=0``, lo and hi must be word-aligned — pod layouts
+    guarantee this (choose_bit_layout's divisibility)."""
     import jax.numpy as jnp
 
     if size % WORD:
         raise ValueError(f"size {size} not divisible by {WORD}")
-    shape = (size // WORD, size) if word_axis == 0 else (size, size // WORD)
+    lo, hi = (0, size) if row_range is None else row_range
+    if not (0 <= lo < hi <= size):
+        raise ValueError(f"row_range {row_range} outside [0, {size})")
+    if word_axis == 0 and (lo % WORD or hi % WORD):
+        raise ValueError(
+            f"row_range {row_range} must be word-aligned for word_axis=0"
+        )
+    nrows = hi - lo
+    shape = packed_shape(nrows, size, word_axis)
     rows, cols, bits = [], [], []
     for x, y in cells:
         if not (0 <= x < size and 0 <= y < size):
             raise ValueError(f"cell ({x}, {y}) outside {size}x{size}")
+        if not (lo <= y < hi):
+            continue
         if word_axis == 0:
-            rows.append(y // WORD)
+            rows.append((y - lo) // WORD)
             cols.append(x)
             bits.append(y % WORD)
         else:
-            rows.append(y)
+            rows.append(y - lo)
             cols.append(x // WORD)
             bits.append(x % WORD)
     packed = np.zeros(shape, np.uint32)

@@ -176,32 +176,54 @@ def load_packed_checkpoint_sharded(path, sharding):
             sharding, packed, packed.shape
         )
         return arr, turn, rule, word_axis
-    with np.load(p, allow_pickle=False) as data:
-        if "packed" not in data or "row0" not in data:
-            raise ValueError(f"{p} is not a sharded packed checkpoint")
-        if int(data["num_processes"]) != nprocs:
+    # Per-rank load + validation is caught, NOT raised: one rank raising
+    # here while its peers proceed into the collective below strands them
+    # in the allgather — a distributed hang instead of a clean error
+    # (ADVICE r4). Every rank always reaches the agreement crossing with
+    # an ok/turn word, mirroring the save path's protocol.
+    err = None
+    rows = turn = rule = word_axis = gshape = None
+    try:
+        with np.load(p, allow_pickle=False) as data:
+            if "packed" not in data or "row0" not in data:
+                raise ValueError(f"{p} is not a sharded packed checkpoint")
+            if int(data["num_processes"]) != nprocs:
+                raise ValueError(
+                    f"{p} was written by {int(data['num_processes'])} "
+                    f"processes; this job has {nprocs}"
+                )
+            rows = data["packed"].astype(np.int32)
+            row0 = int(data["row0"])
+            word_axis = int(data["word_axis"])
+            turn = int(data["turn"])
+            rule = LifeRule.from_rulestring(str(data["rulestring"]))
+            gshape = (int(data["global_rows"]), int(data["global_cols"]))
+        idx_map = sharding.addressable_devices_indices_map(gshape)
+        want_row0 = min(idx[0].start or 0 for idx in idx_map.values())
+        if row0 != want_row0:
             raise ValueError(
-                f"{p} was written by {int(data['num_processes'])} processes; "
-                f"this job has {nprocs}"
+                f"shard {p} holds rows from {row0} but this rank's mesh "
+                f"placement starts at {want_row0}: process/mesh order "
+                "changed since the checkpoint was written"
             )
-        rows = data["packed"].astype(np.int32)
-        row0 = int(data["row0"])
-        word_axis = int(data["word_axis"])
-        turn = int(data["turn"])
-        rule = LifeRule.from_rulestring(str(data["rulestring"]))
-        gshape = (int(data["global_rows"]), int(data["global_cols"]))
-    idx_map = sharding.addressable_devices_indices_map(gshape)
-    want_row0 = min(idx[0].start or 0 for idx in idx_map.values())
-    if row0 != want_row0:
-        raise ValueError(
-            f"shard {p} holds rows from {row0} but this rank's mesh "
-            f"placement starts at {want_row0}: process/mesh order changed "
-            "since the checkpoint was written"
-        )
+    except Exception as exc:
+        err = exc
     if nprocs > 1:
         from jax.experimental import multihost_utils
 
-        turns = multihost_utils.process_allgather(np.int64(turn))
+        word = np.array(
+            [0, -1] if err is not None else [1, turn], dtype=np.int64
+        )
+        agreed = multihost_utils.process_allgather(word)  # (nprocs, 2)
+        if err is not None:
+            raise err
+        failed = int(nprocs - agreed[:, 0].sum())
+        if failed:
+            raise ValueError(
+                f"checkpoint load: shard validation failed on {failed} "
+                f"other rank(s); the job cannot resume from {path}"
+            )
+        turns = agreed[:, 1]
         if int(turns.min()) != int(turns.max()):
             raise ValueError(
                 f"checkpoint shards disagree on the turn "
@@ -209,6 +231,8 @@ def load_packed_checkpoint_sharded(path, sharding):
                 "between per-rank writes left a mixed set; restore from "
                 "an older consistent checkpoint"
             )
+    elif err is not None:
+        raise err
     arr = jax.make_array_from_process_local_data(sharding, rows, gshape)
     return arr, turn, rule, word_axis
 

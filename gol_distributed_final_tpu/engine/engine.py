@@ -463,10 +463,14 @@ class Engine:
             # success anywhere. Every rank reaches this crossing at the
             # same turn (deterministic multi-host chunking), so the
             # allgather is in identical program order.
+            # catch EVERYTHING, not just OSError: a rank that propagates
+            # before its allgather strands every peer inside the
+            # collective — a distributed hang instead of a clean error
+            # (ADVICE r4)
             ok, err = 1, None
             try:
                 save_packed_checkpoint_sharded(path, state, turn, rule, word_axis)
-            except OSError as exc:
+            except Exception as exc:
                 ok, err = 0, exc
             from jax.experimental import multihost_utils
 

@@ -41,6 +41,16 @@ from jax import lax
 WORD = 32
 
 
+def packed_shape(height: int, width: int, word_axis: int = 0) -> tuple[int, int]:
+    """The packed-array shape of a ``height x width`` board: the chosen
+    spatial axis collapses 32 cells into each int32 word. The ONE place
+    this arithmetic lives — seeding, streamed loading, and pod placement
+    all derive their global shapes from it."""
+    if word_axis == 0:
+        return height // WORD, width
+    return height, width // WORD
+
+
 def pack(board: np.ndarray | jax.Array, word_axis: int = 0) -> jax.Array:
     """uint8 {0,255} board -> int32 bitboard. The packed spatial axis must
     be divisible by 32. Bit j of word w along that axis = cell 32*w + j."""

@@ -38,7 +38,7 @@ from typing import Optional
 import numpy as np
 
 from .models import CONWAY, LifeRule
-from .ops.bitpack import WORD, alive_count_packed
+from .ops.bitpack import WORD, alive_count_packed, packed_shape
 
 # control word bits broadcast from rank 0 at each chunk gate
 _CTL_TICK = 1  # all ranks join the count collective; rank 0 emits the event
@@ -139,12 +139,8 @@ def load_packed_from_pgm_sharded(
         rows = read_shard(path, start, stop)
         blocks.append(np.asarray(pack_device(jnp.asarray(rows), word_axis)))
     local = np.concatenate(blocks, axis=0)
-    if word_axis == 0:
-        gshape = (height // WORD, width)
-    else:
-        gshape = (height, width // WORD)
     return jax.make_array_from_process_local_data(
-        packed_sharding(mesh), local, gshape
+        packed_sharding(mesh), local, packed_shape(height, width, word_axis)
     )
 
 
@@ -404,15 +400,16 @@ def pod_session(
         elif cells is not None:
             from .bigboard import seed_packed
 
-            # sparse seeding is cheap enough to do identically on every
-            # rank, then place: each rank keeps only its addressable rows
-            host_local = np.asarray(seed_packed(size, cells, word_axis))
+            # each rank seeds ONLY its addressable row range — no
+            # transient full-board host allocation (ADVICE r4; at
+            # 65536^2 the full packed board is ~512 MiB per rank)
             lo, hi = host_row_range(mesh, size)
-            wlo, whi = (
-                (lo // WORD, hi // WORD) if word_axis == 0 else (lo, hi)
+            host_local = np.asarray(
+                seed_packed(size, cells, word_axis, row_range=(lo, hi))
             )
             state = jax.make_array_from_process_local_data(
-                packed_sharding(mesh), host_local[wlo:whi], host_local.shape
+                packed_sharding(mesh), host_local,
+                packed_shape(size, size, word_axis),
             )
         else:
             raise ValueError("one of resume_from / in_path / cells is required")

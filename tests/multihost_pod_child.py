@@ -125,6 +125,31 @@ def main() -> int:
     rows = read_shard(tmpdir / "out2" / f"{size}x{size}x{turns}.pgm", c - 64, c + 64)
     np.testing.assert_array_equal(win, rows[:, c - 64 : c + 64])
 
+    # phase 3 (ADVICE r4): a bad shard must fail a resume CLEANLY on every
+    # rank — per-rank validation errors are agreed collectively before any
+    # raise, so the GOOD rank gets a ValueError naming the failed peer
+    # instead of stranding forever inside the turn allgather
+    from gol_distributed_final_tpu.engine.checkpoint import (
+        load_packed_checkpoint_sharded,
+    )
+    from gol_distributed_final_tpu.parallel.bit_halo import packed_sharding
+
+    if proc_id == 1:
+        # corrupt THIS rank's own shard: stamp an impossible process count
+        with np.load(shard, allow_pickle=False) as data:
+            fields = {k: data[k] for k in data.files}
+        fields["num_processes"] = np.int64(3)
+        np.savez(shard, **fields)
+    try:
+        load_packed_checkpoint_sharded(ck, packed_sharding(mesh))
+        raise AssertionError("load of a corrupt shard set must fail")
+    except ValueError as exc:
+        msg = str(exc)
+        if proc_id == 1:
+            assert "was written by 3" in msg, msg  # the local validation
+        else:
+            assert "failed on 1 other rank" in msg, msg  # the agreement
+
     print(f"rank {proc_id} done", flush=True)
     return 0
 

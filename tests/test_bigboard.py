@@ -92,6 +92,25 @@ def test_seed_packed_rejects_out_of_range():
         seed_packed(64, [(64, 0)])
 
 
+@pytest.mark.parametrize("word_axis", [0, 1])
+def test_seed_packed_row_range(word_axis):
+    """Per-rank seeding (ADVICE r4): building only the rows of a range
+    yields exactly the matching slice of the full-board seeding; cells
+    outside the range are skipped, cells outside the BOARD still raise."""
+    cells = [(3, 5), (50, 37), (63, 32), (0, 63)]
+    full = np.asarray(seed_packed(64, cells, word_axis))
+    local = np.asarray(
+        seed_packed(64, cells, word_axis, row_range=(32, 64))
+    )
+    wlo, whi = (1, 2) if word_axis == 0 else (32, 64)
+    np.testing.assert_array_equal(local, full[wlo:whi])
+    with pytest.raises(ValueError, match="outside"):
+        seed_packed(64, [(0, 64)], word_axis, row_range=(0, 32))
+    if word_axis == 0:
+        with pytest.raises(ValueError, match="word-aligned"):
+            seed_packed(64, cells, 0, row_range=(8, 40))
+
+
 def test_cli_smoke(tmp_path):
     from gol_distributed_final_tpu import bigboard
 
