@@ -263,6 +263,46 @@ def main() -> int:
         det4k, cell_updates_per_s=round(4096 * 4096 / pt4k)
     )
 
+    # ---- config 6: the mesh tax on one chip (VERDICT r4 item 7) ----------
+    # The SAME packed evolution through the multi-chip code path — a
+    # degenerate (1, 1) mesh: shard_map wrapper, local-wrap halo concats,
+    # (at 4096^2) the tile-aligned ext padding of the pallas local route.
+    # The ratio vs the raw single-chip kernel is the single-chip cost of
+    # keeping the multi-chip path on — the reference's single-worker
+    # fallback story (broker/broker.go:75-107).
+    from gol_distributed_final_tpu.parallel import make_mesh
+    from gol_distributed_final_tpu.parallel.bit_halo import ShardedBitPlane
+
+    mesh11 = make_mesh((1, 1), devices=[dev])
+    for size, src, raw_pt, key in (
+        (512, board, per_turn, "c6_512_mesh_tax"),
+        (4096, b4k, pt4k, "c6_4096_mesh_tax"),
+    ):
+        mplane = ShardedBitPlane(mesh11, CONWAY, word_axis)
+        mstate = mplane.encode(src)
+        # parity vs the single-chip plane, on-device array equality
+        want_m = plane.step_n(plane.encode(src), 100)
+        got_m = mplane.step_n(mstate, 100)
+        if not np.array_equal(np.asarray(got_m), np.asarray(want_m)):
+            print(f"PARITY FAILURE {size}^2 mesh vs plane", file=sys.stderr)
+            return 1
+        print(f"parity {size}^2 mesh(1,1) ok (100 turns)", file=sys.stderr)
+
+        def evolve_mesh(n, mplane=mplane, mstate=mstate):
+            return bitpack.alive_count_packed(mplane.step_n(mstate, n))
+
+        # endpoints sized for the mesh path's expected rate so marginal
+        # work dominates tunnel noise 5x even if the tax is large
+        n6_lo, n6_hi = (20_000, 420_000) if size == 512 else (2_000, 62_000)
+        evolve_mesh(n6_lo), evolve_mesh(n6_hi)
+        pt_mesh, det_mesh = marginal(evolve_mesh, n6_lo, n6_hi, key)
+        extra[key] = dict(
+            det_mesh,
+            cell_updates_per_s=round(size * size / pt_mesh),
+            ratio_vs_raw_kernel=round(pt_mesh / raw_pt, 2),
+        )
+        del evolve_mesh, mstate, mplane
+
     # ---- config 5: 65536^2 sparse (THE BASELINE scale), 16384^2 waypoint --
     # The board exists only as a packed bitboard on device (512 MiB at
     # 65536^2), evolved by the grid-tiled pallas kernel. Timed calls sync

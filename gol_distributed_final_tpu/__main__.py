@@ -96,7 +96,19 @@ def main(argv=None) -> int:
         help="wrap the session in a jax.profiler trace written to DIR "
              "(the reference's TestTrace role, trace_test.go:12-29)",
     )
+    parser.add_argument(
+        "-halo-depth", dest="halo_depth", type=int, default=0,
+        help="with -server: wide-halo depth for the broker's mesh planes "
+             "(turns per halo exchange; 0 = the broker's default)",
+    )
     args = parser.parse_args(argv)
+    if args.halo_depth < 0:
+        parser.error(
+            f"-halo-depth must be >= 1 (or 0 for the broker's default), "
+            f"got {args.halo_depth}"
+        )
+    if args.halo_depth and not args.server:
+        parser.error("-halo-depth needs -server (a mesh-plane broker knob)")
     if args.rule and args.resume:
         parser.error("-rule conflicts with -resume (the checkpoint's rule wins)")
     rule = None
@@ -155,7 +167,8 @@ def main(argv=None) -> int:
             trace_ctx = trace(args.trace)
         with trace_ctx:
             run(params, events, keypresses, broker=broker, rule=rule,
-                emit_flips=emit_flips, resume_from=args.resume)
+                emit_flips=emit_flips, resume_from=args.resume,
+                halo_depth=args.halo_depth)
     finally:
         consumer.join()
         restore_tty()

@@ -77,7 +77,17 @@ class InProcessBroker:
         emit_flips=False,
         initial_turn=0,
         rule=None,
+        halo_depth=0,
     ) -> RunResult:
+        if halo_depth:
+            # accepted-and-rejected cleanly (like a mismatched rule), not
+            # a TypeError mid-session: the knob belongs to mesh-backed
+            # remote brokers, not the in-process engine
+            raise ValueError(
+                "halo_depth needs a mesh-backed broker (e.g. RemoteBroker "
+                "to a tpu-backend server); the in-process engine has no "
+                "mesh-plane knob"
+            )
         if rule is not None and rule.rulestring != self.engine.config.rule.rulestring:
             # a resumed checkpoint's rule must match the engine it resumes
             # on — for the in-process path the session builds the engine
@@ -246,6 +256,7 @@ def run(
     out_dir="out",
     tick_seconds: float = 2.0,
     resume_from=None,
+    halo_depth: int = 0,
 ) -> RunResult:
     """Run a full Game of Life session (gol.Run + distributor, gol/gol.go:12).
 
@@ -259,6 +270,10 @@ def run(
     ``resume_from`` continues from a checkpoint (engine/checkpoint.py)
     instead of loading images/<W>x<H>.pgm at turn 0 — the capability the
     reference lacks (SURVEY.md §5 checkpoint/resume).
+
+    ``halo_depth`` (0 = backend default) ships the wide-halo depth to a
+    remote broker's mesh planes — the DCN lever on the session surface
+    (VERDICT r4 item 5). Only meaningful with ``broker=``.
     """
     initial_turn = 0
     ckpt_rule = None
@@ -321,6 +336,10 @@ def run(
         ):
             wire_rule = engine_config.rule
         extra = {} if wire_rule is None else {"rule": wire_rule}
+        if halo_depth:
+            # only when set, like rule: brokers are duck-typed and the
+            # in-process engine has no mesh-plane knob to turn
+            extra["halo_depth"] = halo_depth
         result = broker.run(
             params,
             world,

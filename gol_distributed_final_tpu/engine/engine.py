@@ -353,14 +353,30 @@ class Engine:
                         inflight.popleft().block_until_ready()
                 elapsed = time.monotonic() - t0
                 if growing:
+                    if multihost:
+                        # the wall-clock cap is rank-local: unagreed it
+                        # could end growth at different sizes on different
+                        # ranks, desynchronising the SPMD dispatch
+                        # sequence. So the ranks AGREE on the slowest
+                        # rank's elapsed — every rank then takes the same
+                        # growth decision, and the dispatch-time target
+                        # holds on a pod too (before this, multihost
+                        # growth was pure doubling to max_chunk, whose
+                        # 4096 default at 65536^2 meant minutes-long
+                        # gates and a starved tick; VERDICT r4 item 6).
+                        # Only growth chunks pay the allgather: <=
+                        # log2(max_chunk) crossings per run, in identical
+                        # program order (growth state is agreed by
+                        # induction).
+                        from jax.experimental import multihost_utils
+
+                        elapsed = float(
+                            multihost_utils.process_allgather(
+                                np.float64(elapsed)
+                            ).max()
+                        )
                     if chunk >= self.config.max_chunk or (
-                        # the wall-clock cap is rank-local: on a multi-host
-                        # mesh it could end growth at different sizes on
-                        # different ranks, desynchronising the SPMD dispatch
-                        # sequence — growth there is pure doubling to
-                        # max_chunk (callers bound latency via max_chunk)
-                        not multihost
-                        and elapsed >= self.config.target_dispatch_seconds
+                        elapsed >= self.config.target_dispatch_seconds
                     ):
                         # whichever way doubling ends — size cap or wall-
                         # clock cap — later chunks go async; the pipelined

@@ -388,10 +388,19 @@ def make_bit_plane(
     rule: LifeRule = CONWAY,
     halo_depth: int = 1,
 ) -> Optional[ShardedBitPlane]:
-    """A ShardedBitPlane for this board/mesh if a packed layout divides,
-    else None (caller falls back to the byte halo plane)."""
+    """A ShardedBitPlane for this board/mesh if a packed layout divides
+    AND the requested halo depth fits its local word blocks, else None
+    (caller falls back to the byte halo plane, whose cell-granular blocks
+    are 8-32x deeper — a small board can support a wide halo there while
+    the packed layout cannot; found by an r5 session drive at 64^2 over
+    a (2, 4) mesh, where the packed blocks are (1, 16) words)."""
+    from ..ops.bitpack import packed_shape
+
     mesh_shape = (mesh.shape[ROWS], mesh.shape[COLS])
     word_axis = choose_bit_layout(board_shape, mesh_shape)
     if word_axis is None:
         return None
+    rows, cols = packed_shape(*board_shape, word_axis)
+    if halo_depth > min(rows // mesh_shape[0], cols // mesh_shape[1]):
+        return None  # a halo can only come from the adjacent device
     return ShardedBitPlane(mesh, rule, word_axis, halo_depth=halo_depth)

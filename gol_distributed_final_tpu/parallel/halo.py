@@ -207,7 +207,10 @@ def sharded_step_n_fn(
     return step_n
 
 
-def make_engine_step(mesh: Mesh, rule: LifeRule = CONWAY) -> Callable:
+def make_engine_step(
+    mesh: Mesh, rule: LifeRule = CONWAY, *, halo_depth: int = 1
+) -> Callable:
     """An ``EngineConfig.step_n_fn``-compatible callable: the engine's turn
-    loop runs the whole mesh as one SPMD program."""
-    return sharded_step_n_fn(mesh, rule)
+    loop runs the whole mesh as one SPMD program. ``halo_depth`` rides
+    through to the wide-halo form (see ``sharded_step_n_fn``)."""
+    return sharded_step_n_fn(mesh, rule, halo_depth=halo_depth)

@@ -44,7 +44,8 @@ from .ops.bitpack import WORD, alive_count_packed, packed_shape
 _CTL_TICK = 1  # all ranks join the count collective; rank 0 emits the event
 _CTL_SNAPSHOT = 2  # all ranks stream their rows to the session PGM
 _CTL_PAUSE = 4  # enter/stay in the pause barrier
-_CTL_QUIT = 8  # engine.quit() on every rank
+_CTL_QUIT = 8  # 'k': engine.quit() on every rank — coordinated shutdown
+_CTL_DETACH = 16  # 'q': rank 0's controller surface closes; run continues
 
 
 def _packed_dims(shape, word_axis: int) -> tuple[int, int]:
@@ -188,7 +189,13 @@ class _PodControl:
     control word; ``multihost_utils.broadcast_one_to_all`` fans it to all
     ranks, which act identically. The pause barrier is a loop of further
     broadcasts — rank 0 re-polling its keyboard between them — so parked
-    ranks stay rendezvoused with rank 0 until resume or quit."""
+    ranks stay rendezvoused with rank 0 until resume or quit.
+
+    Key semantics match the reference's controller/broker split: ``q``
+    detaches the controller (rank 0's event/key surface closes with
+    Quitting + CLOSED; the run continues headless — the pod analogue of
+    the broker surviving a controller quit, gol/distributor.go:64-77),
+    ``k`` is the coordinated full shutdown (broker/broker.go:241-249)."""
 
     def __init__(
         self,
@@ -210,6 +217,8 @@ class _PodControl:
         self.tick_seconds = tick_seconds
         self.is_root = is_root
         self.paused = False
+        self.detached = False  # 'q' pressed: the controller surface closed
+        self._pause_pairs = 0  # toggle-pairs cancelled within one drain
         self._next_tick = time.monotonic() + tick_seconds
 
     # -- rank-0 side -------------------------------------------------------
@@ -218,7 +227,7 @@ class _PodControl:
         import queue as queue_mod
 
         word = 0
-        if self.keypresses is None:
+        if self.keypresses is None or self.detached:
             return word
         while True:
             try:
@@ -229,12 +238,23 @@ class _PodControl:
                 word |= _CTL_SNAPSHOT
             elif key == "p":
                 # XOR, not OR: two presses drained at one gate cancel out
-                # (pause + immediate resume), as two toggles should
+                # (pause + immediate resume), as two toggles should — but
+                # the EVENT stream still shows the Paused/Executing pair,
+                # like the reference handling each press as it arrives
+                # (gol/distributor.go:108-121; ADVICE r4)
+                if word & _CTL_PAUSE:
+                    self._pause_pairs += 1
                 word ^= _CTL_PAUSE
-            elif key in ("q", "k"):
+            elif key == "q":
+                # controller quit (gol/distributor.go:64-77): the event/key
+                # surface closes; the run itself continues headless
+                word |= _CTL_DETACH
+            elif key == "k":
                 word |= _CTL_QUIT
 
     def _root_word(self) -> int:
+        if self.detached:
+            return 0  # controller gone: no keys, no ticker
         word = self._drain_key_word()
         if time.monotonic() >= self._next_tick:
             self._next_tick = time.monotonic() + self.tick_seconds
@@ -273,6 +293,21 @@ class _PodControl:
             )
             if self.is_root:
                 print(self.params.output_filename)
+        if self.is_root and self._pause_pairs:
+            # toggle-pairs cancelled at this gate: the state never changed,
+            # but each press still gets its event, in the order the
+            # reference's press-at-a-time handling would have emitted —
+            # pause/resume from a running board, resume/re-pause from a
+            # paused one. Pairs are rank-0 cosmetics, so no bit rides the
+            # broadcast word for them.
+            for _ in range(self._pause_pairs):
+                if self.paused:
+                    self.events.put(StateChange(turn - 1, State.EXECUTING))
+                    self.events.put(StateChange(turn, State.PAUSED))
+                else:
+                    self.events.put(StateChange(turn, State.PAUSED))
+                    self.events.put(StateChange(turn - 1, State.EXECUTING))
+            self._pause_pairs = 0
         if word & _CTL_PAUSE:
             self.paused = not self.paused
             if self.is_root:
@@ -283,8 +318,22 @@ class _PodControl:
                     )
                 )
                 print("State paused" if self.paused else "State unpaused")
+        if word & _CTL_DETACH:
+            # 'q' (gol/distributor.go:64-77 + README.md:187): the
+            # controller detaches — StateChange{Quitting} then CLOSED end
+            # rank 0's event stream, keys stop being consulted, and the
+            # run continues headless to completion (a paused board is
+            # resumed first: nobody is left to unpause it)
+            from .engine.controller import CLOSED
+
+            self.paused = False
+            if self.is_root and not self.detached:
+                self.events.put(StateChange(turn, Quitting))
+                self.events.put(CLOSED)
+            self.detached = True
         if word & _CTL_QUIT:
-            if self.is_root:
+            # 'k' (broker/broker.go:241-249): coordinated full shutdown
+            if self.is_root and not self.detached:
                 self.events.put(StateChange(turn, Quitting))
             engine.quit()
 
@@ -362,13 +411,15 @@ def pod_session(
     is_root = jax.process_index() == 0
     if events is None:
         events = queue_mod.Queue()
+    control = None
     try:
         mesh_shape = (mesh.shape[ROWS], mesh.shape[COLS])
         plane = make_bit_plane(mesh, (size, size), rule, halo_depth=halo_depth)
         if plane is None:
             raise ValueError(
                 f"no packed layout of {size}x{size} divides over mesh "
-                f"{mesh_shape}"
+                f"{mesh_shape} with halo_depth={halo_depth} (the depth is "
+                "bounded by the local word blocks)"
             )
         word_axis = plane.word_axis
         params = Params(turns=turns, image_width=size, image_height=size)
@@ -450,12 +501,16 @@ def pod_session(
         # count on EVERY rank: a later rank-local result.alive_count must
         # not fire a collective outside the gate protocol
         result._alive = _CountOnlyAlive(count)
-        if is_root:
+        # after a 'q' detach the controller surface already closed (the
+        # Quitting + CLOSED pair went out at the gate): the run still
+        # streams its output PGM, but emits no further events
+        emit = is_root and not control.detached
+        if emit:
             events.put(
                 FinalTurnComplete(result.turns_completed, _CountOnlyAlive(count))
             )
         stream_packed_to_pgm_sharded(out_file, final, word_axis, row_block)
-        if is_root:
+        if emit:
             events.put(
                 ImageOutputComplete(
                     result.turns_completed, params.output_filename
@@ -464,7 +519,8 @@ def pod_session(
             events.put(StateChange(result.turns_completed, Quitting))
         return result
     finally:
-        events.put(CLOSED)
+        if control is None or not control.detached:
+            events.put(CLOSED)
 
 
 def main(argv=None) -> int:

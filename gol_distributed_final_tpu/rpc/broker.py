@@ -40,17 +40,22 @@ class TpuBackend:
     that land before Run has initialised are buffered by the engine's own
     pending-control semantics instead of being dropped."""
 
-    def __init__(self, use_mesh: bool = True):
+    def __init__(self, use_mesh: bool = True, halo_depth: int = 1):
+        if halo_depth < 1:
+            raise ValueError(f"halo_depth must be >= 1, got {halo_depth}")
         self._use_mesh = use_mesh
+        self._halo_depth = halo_depth  # the -halo-depth server default
         self.engine = Engine()
         self._planes: dict = {}
 
-    def _plane_for(self, height: int, width: int, rule):
+    def _plane_for(self, height: int, width: int, rule, halo_depth: int):
         """A mesh data plane if the local devices divide the board — the
         bit-packed halo plane when a packed layout divides too (the fast
         kernel on every 'worker', parallel/bit_halo.py), else the byte halo
-        plane; None for a single device (the engine auto-picks)."""
-        key = (height, width, rule.rulestring)
+        plane; None for a single device (the engine auto-picks).
+        ``halo_depth`` turns per halo exchange on either mesh plane — the
+        DCN lever on the deployment surface (VERDICT r4 item 5)."""
+        key = (height, width, rule.rulestring, halo_depth)
         if key not in self._planes:
             plane = None
             if self._use_mesh:
@@ -63,11 +68,36 @@ class TpuBackend:
                 if len(jax.devices()) > 1:
                     try:
                         mesh = make_mesh(height=height, width=width)
-                        plane = make_bit_plane(mesh, (height, width), rule)
-                        if plane is None:
-                            plane = BytePlane(rule, make_engine_step(mesh, rule))
+                        nrows, ncols = (
+                            mesh.shape["rows"], mesh.shape["cols"],
+                        )
+                        plane = make_bit_plane(
+                            mesh, (height, width), rule, halo_depth=halo_depth
+                        )
+                        if plane is None and halo_depth <= min(
+                            height // nrows, width // ncols
+                        ):
+                            # byte-plane fallback: cell-granular blocks are
+                            # 32x deeper than word blocks, so a board too
+                            # small for the packed layout at this depth
+                            # can still honor it here
+                            plane = BytePlane(
+                                rule,
+                                make_engine_step(
+                                    mesh, rule, halo_depth=halo_depth
+                                ),
+                            )
                     except ValueError:
                         pass  # indivisible board: single-device engine
+            if plane is None and halo_depth > 1:
+                # the knob cannot be honored at all (single device, or a
+                # board smaller than the depth on every mesh plane):
+                # refuse loudly rather than silently running at depth 1
+                raise ValueError(
+                    f"halo_depth {halo_depth} cannot be honored for "
+                    f"{width}x{height} on this backend (no mesh plane "
+                    "supports it); drop -halo-depth or grow the board"
+                )
             if plane is None and rule.rulestring != self.engine.config.rule.rulestring:
                 # single-device non-default rule (a resumed checkpoint):
                 # the engine would auto-pick with ITS config rule, so the
@@ -97,7 +127,9 @@ class TpuBackend:
             from ..models import LifeRule
 
             rule = LifeRule.from_rulestring(req.rulestring)
-        plane = self._plane_for(req.image_height, req.image_width, rule)
+        # 0 on the wire = "the server's default" (like rulestring's "")
+        depth = req.halo_depth if req.halo_depth else self._halo_depth
+        plane = self._plane_for(req.image_height, req.image_width, rule, depth)
         return self.engine.run(
             params, req.world, plane=plane, initial_turn=req.initial_turn
         )
@@ -151,6 +183,14 @@ class WorkersBackend:
     def run(self, req: Request) -> RunResult:
         if not self.clients:
             raise RpcError("no workers connected")
+        if req.halo_depth > 1:
+            # wide halos are a mesh-plane knob; the reference-shaped
+            # scatter/gather has no equivalent — refuse rather than
+            # silently running at depth 1
+            raise RpcError(
+                "the workers backend has no halo_depth knob; use "
+                "-backend tpu for wide halos"
+            )
         if req.rulestring:
             # the reference-shaped workers hard-code Conway
             # (worker/worker.go:41-46, mirrored in rpc/worker._strip_step);
@@ -410,12 +450,13 @@ def serve(
     worker_addresses: list[str] | None = None,
     host: str = "127.0.0.1",
     wire: str = "haloed",
+    halo_depth: int = 1,
 ) -> tuple[RpcServer, BrokerService]:
     server = RpcServer(host=host, port=port)
     impl = (
         WorkersBackend(worker_addresses or [], wire=wire)
         if backend == "workers"
-        else TpuBackend()
+        else TpuBackend(halo_depth=halo_depth)
     )
     service = BrokerService(server, impl)
     server.register(Methods.BROKER_RUN, service.run)
@@ -448,10 +489,20 @@ def main(argv=None) -> None:
              "bytes, default) or the reference-exact full board "
              "(broker/broker.go:144)",
     )
+    parser.add_argument(
+        "-halo-depth", dest="halo_depth", type=int, default=1,
+        help="tpu backend: turns per halo exchange on the mesh planes "
+             "(wide halos — raise on DCN-crossed meshes)",
+    )
     args = parser.parse_args(argv)
+    if args.halo_depth < 1:
+        parser.error(f"-halo-depth must be >= 1, got {args.halo_depth}")
+    if args.halo_depth > 1 and args.backend != "tpu":
+        parser.error("-halo-depth is a tpu-backend knob (mesh planes)")
     addresses = [a for a in args.workers.split(",") if a]
     server, service = serve(
-        args.port, args.backend, addresses, host=args.host, wire=args.wire
+        args.port, args.backend, addresses, host=args.host, wire=args.wire,
+        halo_depth=args.halo_depth,
     )
     print(f"broker listening on :{server.port} (backend={args.backend})", flush=True)
     service.quit_event.wait()

@@ -112,6 +112,7 @@ class RemoteBroker:
         emit_flips=False,
         initial_turn=0,
         rule=None,
+        halo_depth=0,
     ):
         # emit/emit_flips are single-host features; the distributed reference
         # never emits CellFlipped/TurnComplete either (SURVEY.md §4 TestSdl note)
@@ -123,6 +124,7 @@ class RemoteBroker:
             threads=params.threads,
             initial_turn=initial_turn,
             rulestring=rule.rulestring if rule is not None else "",
+            halo_depth=halo_depth,  # 0 = the server's -halo-depth default
         )
         res = self.client.call(Methods.BROKER_RUN, req)
         from ..engine.engine import RunResult

@@ -54,6 +54,11 @@ class Request:
     # default). Without it a remote resume of e.g. a HIGHLIFE checkpoint
     # would silently continue under Conway.
     rulestring: str = ""
+    # extension: wide-halo depth for the tpu backend's mesh planes (0 =
+    # the server's -halo-depth default) — the DCN-latency lever must be
+    # reachable from the deployment surface, not only the library
+    # (VERDICT r4 item 5)
+    halo_depth: int = 0
 
 
 @dataclasses.dataclass

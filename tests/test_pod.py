@@ -110,7 +110,9 @@ def test_pod_session_end_to_end(tmp_path):
 def test_pod_session_pause_snapshot_quit(tmp_path):
     """The keyboard surface through the chunk gate: 's' streams a
     snapshot, 'p'/'p' pause and resume (with the turn-1 resume quirk and
-    tick suppression while paused), 'q' quits early."""
+    tick suppression while paused), 'k' shuts the whole session down
+    early (broker/broker.go:241-249 — 'q' no longer stops the run, see
+    test_pod_q_detaches_controller)."""
     import threading
     import time
 
@@ -130,13 +132,13 @@ def test_pod_session_pause_snapshot_quit(tmp_path):
         time.sleep(0.5)
         keys.put("p")
         time.sleep(0.2)
-        keys.put("q")
+        keys.put("k")
 
     feeder = threading.Thread(target=feed)
     feeder.start()
     res = pod_session(
         SIZE,
-        1_000_000,  # 'q' must end it
+        1_000_000,  # 'k' must end it
         mesh,
         in_path=in_path,
         events=events,
@@ -164,9 +166,115 @@ def test_pod_session_pause_snapshot_quit(tmp_path):
         isinstance(e, AliveCellsCount) for e in seq[i0 + 1 : i1]
     ), "tick emitted while paused"
     quits = [e for e in changes if e.new_state is Quitting]
-    assert len(quits) == 2  # one from 'q', one from the closing sequence
+    assert len(quits) == 2  # one from 'k', one from the closing sequence
     # the snapshot (and later the final write) landed at the session path
     assert (tmp_path / "out" / f"{SIZE}x{SIZE}x1000000.pgm").exists()
+
+
+def test_pod_q_detaches_controller(tmp_path):
+    """Reference q semantics on the pod (VERDICT r4 item 4,
+    gol/distributor.go:64-77 + README.md:187): 'q' closes the CONTROLLER
+    — rank 0's event stream ends with StateChange{Quitting} then CLOSED —
+    while the run itself continues headless to completion and still
+    streams its output PGM. 'k' (the other test) is the coordinated full
+    shutdown."""
+    board = _random_board(7)
+    in_path = tmp_path / f"{SIZE}x{SIZE}.pgm"
+    _write_pgm(in_path, board)
+    events = queue.Queue()
+    keys = queue.Queue()
+    keys.put("q")  # drained at the FIRST gate: detach almost immediately
+    res = pod_session(
+        SIZE,
+        TURNS,
+        mesh := make_mesh((2, 4)),
+        in_path=in_path,
+        events=events,
+        keypresses=keys,
+        tick_seconds=3600,
+        out_dir=tmp_path / "out",
+        min_chunk=2,
+        max_chunk=2,
+    )
+    # the run completed EVERY turn despite the early 'q'
+    assert res.turns_completed == TURNS
+    seq = _drain(events)
+    # the controller saw exactly the detach pair and nothing after: no
+    # FinalTurnComplete / ImageOutputComplete ride a closed surface
+    assert isinstance(seq[-1], StateChange) and seq[-1].new_state is Quitting
+    assert seq[-1].completed_turns == 2  # the first gate
+    assert not any(isinstance(e, FinalTurnComplete) for e in seq)
+    assert not any(isinstance(e, ImageOutputComplete) for e in seq)
+    # the output obligation stands: final PGM is golden vs the oracle
+    got = (tmp_path / "out" / f"{SIZE}x{SIZE}x{TURNS}.pgm").read_bytes()
+    want = _oracle(board, TURNS)
+    assert got == b"P5\n%d %d\n255\n" % (SIZE, SIZE) + want.tobytes()
+
+
+def test_pod_cancelled_pause_pair_still_emits_events(tmp_path):
+    """Two 'p' presses drained at ONE gate cancel (the board never
+    pauses) but the event stream still shows the Paused/Executing pair,
+    like the reference handling each press as it arrives
+    (gol/distributor.go:108-121; ADVICE r4)."""
+    board = _random_board(8)
+    in_path = tmp_path / f"{SIZE}x{SIZE}.pgm"
+    _write_pgm(in_path, board)
+    events = queue.Queue()
+    keys = queue.Queue()
+    keys.put("p")
+    keys.put("p")  # both drain at the first gate: XOR-cancelled
+    res = pod_session(
+        SIZE,
+        TURNS,
+        make_mesh((2, 4)),
+        in_path=in_path,
+        events=events,
+        keypresses=keys,
+        tick_seconds=3600,
+        out_dir=tmp_path / "out",
+        min_chunk=2,
+        max_chunk=2,
+    )
+    assert res.turns_completed == TURNS  # never actually paused
+    seq = _drain(events)
+    changes = [e for e in seq if isinstance(e, StateChange)]
+    paused = [e for e in changes if e.new_state == State.PAUSED]
+    executing = [e for e in changes if e.new_state == State.EXECUTING]
+    assert len(paused) == 1 and len(executing) == 1
+    # adjacent in the stream, with the same turn arithmetic a real
+    # pause/resume across one gate would have shown
+    i0, i1 = seq.index(paused[0]), seq.index(executing[0])
+    assert i1 == i0 + 1
+    assert executing[0].completed_turns == paused[0].completed_turns - 1
+
+
+def test_pod_pause_pair_order_matches_state(tmp_path):
+    """The cancelled-pair events mirror what press-at-a-time handling
+    would emit: Paused/Executing from a running board, but
+    Executing/Paused (resume, re-pause) when drained INSIDE the pause
+    barrier — the stream must never end on a state opposite to
+    reality."""
+    from gol_distributed_final_tpu.events import State, StateChange
+    from gol_distributed_final_tpu.pod import _PodControl
+    from gol_distributed_final_tpu.params import Params
+
+    def pair_events(paused):
+        events = queue.Queue()
+        control = _PodControl(
+            Params(turns=4, image_width=64, image_height=64),
+            events, queue.Queue(), tmp_path / "x.pgm", 0, 64, 3600, True,
+        )
+        control.paused = paused
+        control._pause_pairs = 1
+        control._apply(None, None, 3, 0)
+        out = []
+        while not events.empty():
+            out.append(events.get_nowait())
+        return [(e.completed_turns, e.new_state) for e in out
+                if isinstance(e, StateChange)]
+
+    assert pair_events(False) == [(3, State.PAUSED), (2, State.EXECUTING)]
+    assert pair_events(True) == [(2, State.EXECUTING), (3, State.PAUSED)]
 
 
 def test_pod_checkpoint_and_resume(tmp_path):

@@ -22,12 +22,29 @@ from gol_distributed_final_tpu.rpc.protocol import Methods, Request
 from helpers import REPO_ROOT, assert_equal_board, read_alive_cells
 
 
-def _spawn(module: str, *args: str) -> subprocess.Popen:
+def _spawn(module: str, *args: str, devices: int = 1) -> subprocess.Popen:
     env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env.update(
+        {"JAX_PLATFORMS": "cpu",
+         "XLA_FLAGS": f"--xla_force_host_platform_device_count={devices}"}
+    )
+    # env vars alone are NOT enough here: the ambient sitecustomize
+    # registers the real-TPU plugin at interpreter start and the child
+    # would land on it (1 device) regardless — the takeover must go
+    # through jax.config before any device query, exactly like
+    # tests/conftest.py and the dryrun child (utils/cpumesh.py). Found in
+    # r5: every spawned broker/worker had been running single-real-TPU,
+    # so multi-device broker paths were never actually exercised.
+    code = (
+        "import sys, runpy; "
+        "from gol_distributed_final_tpu.utils.cpumesh import "
+        "force_virtual_cpu_devices; "
+        f"assert force_virtual_cpu_devices({devices}); "
+        f"sys.argv[0] = {module!r}; "
+        f"runpy.run_module({module!r}, run_name='__main__')"
+    )
     proc = subprocess.Popen(
-        [sys.executable, "-m", module, *args],
+        [sys.executable, "-c", code, *args],
         cwd=REPO_ROOT,
         env=env,
         stdout=subprocess.PIPE,
@@ -119,6 +136,104 @@ def test_tpu_backend_golden(tpu_broker, tmp_path):
     result, events = _run_remote(address, 64, 100, tmp_path)
     expected = read_alive_cells(REPO_ROOT / "check" / "images" / "64x64x100.pgm")
     assert_equal_board(result.alive, expected, 64, 64)
+
+
+def test_tpu_backend_wide_halo_golden(tmp_path):
+    """The -halo-depth knob on the DEPLOYMENT surface (VERDICT r4 item 5):
+    a broker started with 8 devices and -halo-depth 2 serves remote runs
+    through its wide-halo mesh planes, golden-exact. The RPC verbs — not
+    only the library API — can turn the DCN lever. Both plane routes are
+    proven: 512^2 rides the PACKED wide plane (blocks (8, 128) words over
+    the (2, 4) mesh), 64^2 falls back to the byte wide plane (its packed
+    blocks would be (1, 16) words — too shallow for depth 2)."""
+    broker = _spawn(
+        "gol_distributed_final_tpu.rpc.broker",
+        "-port", "0", "-halo-depth", "2",
+        devices=8,
+    )
+    try:
+        port = _wait_listening(broker)
+        address = f"127.0.0.1:{port}"
+        for size in (512, 64):
+            result, _ = _run_remote(address, size, 100, tmp_path)
+            expected = read_alive_cells(
+                REPO_ROOT / "check" / "images" / f"{size}x{size}x100.pgm"
+            )
+            assert_equal_board(result.alive, expected, size, size)
+    finally:
+        if broker.poll() is None:
+            broker.kill()
+        broker.wait()
+
+
+def test_request_halo_depth_rides_the_wire(tmp_path):
+    """The per-request override (Request.halo_depth, 0 = server default):
+    a depth-1 broker serves a -halo-depth 2 SESSION golden-exact — the
+    controller CLI's knob reaches the remote mesh planes."""
+    broker = _spawn(
+        "gol_distributed_final_tpu.rpc.broker", "-port", "0", devices=8
+    )
+    try:
+        port = _wait_listening(broker)
+        p = Params(turns=100, threads=8, image_width=64, image_height=64)
+        remote = RemoteBroker(f"127.0.0.1:{port}")
+        try:
+            result = run(
+                p,
+                queue.Queue(),
+                broker=remote,
+                images_dir=REPO_ROOT / "images",
+                out_dir=tmp_path / "out",
+                tick_seconds=3600.0,
+                halo_depth=2,
+            )
+        finally:
+            remote.close()
+        expected = read_alive_cells(
+            REPO_ROOT / "check" / "images" / "64x64x100.pgm"
+        )
+        assert_equal_board(result.alive, expected, 64, 64)
+    finally:
+        if broker.poll() is None:
+            broker.kill()
+        broker.wait()
+
+
+def test_halo_depth_requires_mesh_broker(tmp_path):
+    """run(halo_depth=N) without a remote broker is a clean ValueError
+    (like a mismatched rule), not a TypeError mid-session — the knob
+    belongs to mesh-backed brokers."""
+    p = Params(turns=4, image_width=16, image_height=16)
+    with pytest.raises(ValueError, match="halo_depth"):
+        run(
+            p,
+            queue.Queue(),
+            images_dir=REPO_ROOT / "images",
+            out_dir=tmp_path / "out",
+            tick_seconds=3600.0,
+            halo_depth=2,
+        )
+
+
+def test_workers_backend_rejects_halo_depth(worker_cluster, tmp_path):
+    """Wide halos are a mesh-plane knob: the reference-shaped workers
+    backend refuses rather than silently running at depth 1."""
+    address, _, _ = worker_cluster
+    p = Params(turns=4, threads=2, image_width=16, image_height=16)
+    remote = RemoteBroker(address)
+    try:
+        with pytest.raises(RpcError, match="halo_depth"):
+            run(
+                p,
+                queue.Queue(),
+                broker=remote,
+                images_dir=REPO_ROOT / "images",
+                out_dir=tmp_path / "out",
+                tick_seconds=3600.0,
+                halo_depth=2,
+            )
+    finally:
+        remote.close()
 
 
 def test_detach_reattach(tpu_broker, tmp_path):
@@ -406,7 +521,7 @@ def test_tpu_backend_mesh_routing_in_process():
     )
     from gol_distributed_final_tpu.models import CONWAY
 
-    assert isinstance(backend._plane_for(64, 64, CONWAY), ShardedBitPlane)
+    assert isinstance(backend._plane_for(64, 64, CONWAY, 1), ShardedBitPlane)
     assert res.alive == []  # Run's reply ships the world, never the cells
     expected = read_alive_cells(REPO_ROOT / "check" / "images" / "64x64x100.pgm")
     assert res.alive_count == len(expected)
