@@ -40,7 +40,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models import CONWAY, LifeRule
 from ..ops.bitpack import WORD, bit_step, pack_device, unpack_device
-from .halo import _exchange, check_halo_depth, wide_loop
+from .halo import _exchange, check_halo_depth, halo_depth_fits, wide_loop
 from .mesh import COLS, ROWS
 
 
@@ -401,6 +401,8 @@ def make_bit_plane(
     if word_axis is None:
         return None
     rows, cols = packed_shape(*board_shape, word_axis)
-    if halo_depth > min(rows // mesh_shape[0], cols // mesh_shape[1]):
+    if not halo_depth_fits(
+        halo_depth, (rows // mesh_shape[0], cols // mesh_shape[1])
+    ):
         return None  # a halo can only come from the adjacent device
     return ShardedBitPlane(mesh, rule, word_axis, halo_depth=halo_depth)

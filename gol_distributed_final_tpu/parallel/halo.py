@@ -110,11 +110,19 @@ def wide_loop(block, n: int, depth: int, step, wide):
     return lax.fori_loop(0, n, lambda _, b: step(b), block)
 
 
-def check_halo_depth(depth: int, block_shape) -> None:
+def halo_depth_fits(depth: int, block_shape) -> bool:
     """A halo can only come from the adjacent device: depth is bounded by
-    the local block's smaller dimension. Shared by both planes so the
+    the local block's smaller dimension. The ONE copy of the bound —
+    step-time checks (``check_halo_depth``) and admission guards (the
+    broker's plane routing, ``make_bit_plane``) all call it, so they
+    cannot drift apart."""
+    return depth <= min(block_shape)
+
+
+def check_halo_depth(depth: int, block_shape) -> None:
+    """Raise-form of ``halo_depth_fits``, shared by both planes so the
     error names the knob the user actually set."""
-    if depth > min(block_shape):
+    if not halo_depth_fits(depth, block_shape):
         raise ValueError(
             f"halo_depth {depth} exceeds the local block "
             f"{tuple(block_shape)}: a halo can only come from the "

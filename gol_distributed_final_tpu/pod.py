@@ -247,8 +247,12 @@ class _PodControl:
                 word ^= _CTL_PAUSE
             elif key == "q":
                 # controller quit (gol/distributor.go:64-77): the event/key
-                # surface closes; the run itself continues headless
+                # surface closes — keys queued BEHIND the 'q' belong to a
+                # closed surface and are never consulted, so draining stops
+                # here (keys before it were legitimately pressed first and
+                # ride this word)
                 word |= _CTL_DETACH
+                return word
             elif key == "k":
                 word |= _CTL_QUIT
 

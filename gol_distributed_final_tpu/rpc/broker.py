@@ -71,11 +71,13 @@ class TpuBackend:
                         nrows, ncols = (
                             mesh.shape["rows"], mesh.shape["cols"],
                         )
+                        from ..parallel.halo import halo_depth_fits
+
                         plane = make_bit_plane(
                             mesh, (height, width), rule, halo_depth=halo_depth
                         )
-                        if plane is None and halo_depth <= min(
-                            height // nrows, width // ncols
+                        if plane is None and halo_depth_fits(
+                            halo_depth, (height // nrows, width // ncols)
                         ):
                             # byte-plane fallback: cell-granular blocks are
                             # 32x deeper than word blocks, so a board too

@@ -248,6 +248,39 @@ def test_pod_cancelled_pause_pair_still_emits_events(tmp_path):
     assert executing[0].completed_turns == paused[0].completed_turns - 1
 
 
+def test_pod_keys_behind_q_are_not_consulted(tmp_path):
+    """A 'k' (or any key) queued BEHIND the 'q' in the same gate drain
+    belongs to the closed controller surface: the run must still complete
+    headless, not be killed by the stale 'k'."""
+    board = _random_board(10)
+    in_path = tmp_path / f"{SIZE}x{SIZE}.pgm"
+    _write_pgm(in_path, board)
+    keys = queue.Queue()
+    keys.put("q")
+    keys.put("k")  # behind the detach: dead surface, never consulted
+    res = pod_session(
+        SIZE, TURNS, make_mesh((2, 4)), in_path=in_path,
+        events=queue.Queue(), keypresses=keys, tick_seconds=3600,
+        out_dir=tmp_path / "out", min_chunk=2, max_chunk=2,
+    )
+    assert res.turns_completed == TURNS
+
+
+def test_pod_rejects_depth_too_deep_for_blocks(tmp_path):
+    """A board whose packed layout cannot carry the requested halo depth
+    fails at session entry with an error naming the knob — not hours in
+    with a shard_map error."""
+    board = _random_board(9, size=64)
+    in_path = tmp_path / "64x64.pgm"
+    _write_pgm(in_path, board)
+    with pytest.raises(ValueError, match="halo_depth=2"):
+        pod_session(
+            64, 10, make_mesh((2, 4)), in_path=in_path,
+            events=queue.Queue(), tick_seconds=3600,
+            out_dir=tmp_path / "out", halo_depth=2,
+        )
+
+
 def test_pod_pause_pair_order_matches_state(tmp_path):
     """The cancelled-pair events mirror what press-at-a-time handling
     would emit: Paused/Executing from a running board, but

@@ -156,6 +156,36 @@ def test_bitplane_routes_vmem_then_tiled():
     )
 
 
+@pytest.mark.parametrize("depth", [2, 8])
+def test_pallas_wide_halo_compiled(depth):
+    """Wide halos through the COMPILED tiled kernel (the r5 composition —
+    the CPU suite only runs it in interpret mode): a (1, 1) mesh on the
+    real chip builds the k-word-halo tile-aligned ext and runs k Mosaic
+    kernel launches per exchange; parity vs the XLA bitboard, including
+    the depth-8 ring-creep boundary and a remainder turn count."""
+    from gol_distributed_final_tpu.parallel import make_mesh
+    from gol_distributed_final_tpu.parallel.bit_halo import (
+        packed_sharding,
+        sharded_bit_step_n_fn,
+    )
+
+    mesh = make_mesh((1, 1), devices=[jax.devices()[0]])
+    packed = jax.device_put(
+        _random_packed(7, (64, 2048)), packed_sharding(mesh)
+    )  # 2048^2: ext (80, 2304) tiles; min block dim 64 >= depth 8
+    wide = sharded_bit_step_n_fn(
+        mesh, pallas_local=True, interpret=False, halo_depth=depth
+    )
+    for n in (depth, depth + 1):  # exact and remainder chunking
+        got = np.asarray(wide(packed, n))
+        want = np.asarray(
+            bitpack.bit_step_n(
+                packed, n, 0, CONWAY.birth_mask, CONWAY.survive_mask
+            )
+        )
+        np.testing.assert_array_equal(got, want, err_msg=f"depth={depth} n={n}")
+
+
 def test_byte_vmem_kernel_matches_roll_stencil():
     """The byte-board VMEM kernel (pallas_step_n_fn, compiled) vs the XLA
     roll stencil at 512^2 x 50 turns under HIGHLIFE."""
