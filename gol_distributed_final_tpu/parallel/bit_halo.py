@@ -109,10 +109,12 @@ def _local_bit_step_pallas(
 
     Beyond the whole-board VMEM gate, the XLA ``bit_step`` spills its
     ~10 bit-plane temporaries to HBM — ~5x slower per device at 16384^2
-    (the single-chip finding, ops/pallas_tiled.py). On a multi-chip mesh
-    each device's LOCAL block crosses that same gate long before the
-    global board is large, so the local compute routes to the pallas
-    kernel.
+    (the single-chip finding, ops/pallas_tiled.py). Inside shard_map the
+    kernel wins at EVERY aligned size, not just past the gate (r5 chip
+    sweep: 1.6-2.8x, see ``_auto_use_pallas``): the XLA local step
+    materialises the haloed ext and its temporaries through HBM each
+    turn even when a raw single-chip ``bit_step`` of the same size would
+    stay fused.
 
     The kernel needs a sublane/lane-ALIGNED extended block, but only the
     innermost ``depth`` halo words ever feed the kept interior (turn t
@@ -149,29 +151,25 @@ def _auto_use_pallas(
     halo_depth: int, block_shape, word_axis: int, interpret: bool
 ) -> bool:
     """The ``pallas_local=None`` routing decision: the tiled kernel runs
-    when the local block is past the VMEM gate AND the halo depth fits
-    the aligned-ext form's sublane bound (8) — deeper halos silently stay
-    on the XLA local step, which has no depth ceiling."""
+    whenever the local block is tile-ALIGNED (word_axis=0) and the halo
+    depth fits the aligned-ext form's sublane bound (8) — deeper halos
+    silently stay on the XLA local step, which has no depth ceiling.
+
+    Until r5 this also required the block to be past the VMEM working-set
+    gate, on the theory that XLA handles VMEM-resident blocks fine. A
+    real-chip sweep (r5, (1,1) mesh) measured the pallas route faster at
+    EVERY size — 2.8x at 256^2, 2.1x at 512^2, 1.8x at 1024^2, 1.6x at
+    2048^2 — because inside shard_map the XLA local step materialises the
+    haloed ext and its bit-plane temporaries through HBM every turn,
+    while the kernel keeps them in VMEM. So alignment is the only gate."""
     from ..ops.pallas_tiled import _SUBLANE
 
     return (
         halo_depth <= _SUBLANE
-        and _pallas_local_ok(block_shape, word_axis)
+        and word_axis == 0
+        and _pallas_local_aligned(block_shape)
         and not interpret
     )
-
-
-def _pallas_local_ok(block_shape, word_axis: int) -> bool:
-    """Route the local step to pallas when the LOCAL block is past the
-    VMEM working-set gate (where XLA starts spilling) and the tile-aligned
-    halo scheme applies."""
-    from ..ops.pallas_stencil import fits_vmem
-
-    if word_axis != 0:
-        return False
-    if not _pallas_local_aligned(block_shape):
-        return False
-    return not fits_vmem(block_shape, itemsize=4)
 
 
 def _pallas_local_aligned(block_shape) -> bool:
@@ -206,9 +204,9 @@ def sharded_bit_step_n_fn(
     included) inside shard_map.
 
     ``pallas_local`` routes each device's local compute through the
-    grid-tiled pallas kernel (None = auto: on real TPU when the local
-    block is past the VMEM gate where XLA spills; see
-    ``_pallas_local_ok``). ``interpret`` forces pallas interpret mode —
+    grid-tiled pallas kernel (None = auto: on real TPU whenever the local
+    block is tile-aligned — measured faster at every size, see
+    ``_auto_use_pallas``). ``interpret`` forces pallas interpret mode —
     the CPU-mesh test hook.
 
     ``halo_depth=k`` exchanges k-deep halos and runs k turns locally per

@@ -229,7 +229,6 @@ def test_pallas_local_step_parity_on_mesh():
     boundaries — the multi-chip large-board path, exercised in interpret
     mode on the CPU mesh."""
     from gol_distributed_final_tpu.parallel.bit_halo import (
-        _pallas_local_ok,
         packed_sharding,
         sharded_bit_step_n_fn,
     )
@@ -249,15 +248,17 @@ def test_pallas_local_step_parity_on_mesh():
 
 
 def test_pallas_local_routing_gate():
-    """Auto-routing: local blocks past the VMEM working-set gate route to
-    pallas; small blocks and misaligned shapes stay on the XLA step."""
-    from gol_distributed_final_tpu.parallel.bit_halo import _pallas_local_ok
+    """Auto-routing: every tile-ALIGNED row-packed block routes to pallas
+    (the r5 real-chip sweep measured it faster at every size); misaligned
+    shapes and column packing stay on the XLA step."""
+    from gol_distributed_final_tpu.parallel.bit_halo import _auto_use_pallas
 
-    assert _pallas_local_ok((128, 8192), 0)  # 16384^2 over 4 chips: spills
-    assert not _pallas_local_ok((16, 256), 0)  # small: XLA/VMEM kernel fine
-    assert not _pallas_local_ok((12, 8192), 0)  # sublane-misaligned
-    assert not _pallas_local_ok((128, 8200), 0)  # lane-misaligned
-    assert not _pallas_local_ok((8192, 128), 1)  # column packing unsupported
+    ok = lambda shape, axis: _auto_use_pallas(1, shape, axis, interpret=False)
+    assert ok((128, 8192), 0)  # 16384^2 over 4 chips
+    assert ok((16, 256), 0)  # small aligned block: pallas still wins (r5)
+    assert not ok((12, 8192), 0)  # sublane-misaligned
+    assert not ok((128, 8200), 0)  # lane-misaligned
+    assert not ok((8192, 128), 1)  # column packing unsupported
 
 
 class TestWideHalos:
