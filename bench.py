@@ -140,6 +140,27 @@ def marginal(time_fn, n_lo, n_hi, label="?"):
     return per_turn, details
 
 
+def gated(time_fn, n_lo, n_hi, label, attempts=3):
+    """``marginal`` with a bounded retry: the tunnel's occasional one-sided
+    latency spikes can push a single sampling below the noise margin
+    (observed once in three r5 full runs, on the untouched c2 config) —
+    a fresh sampling recovers, a REAL noise problem still fails after
+    ``attempts``. Never weakens the gate itself."""
+    last = None
+    for i in range(attempts):
+        try:
+            return marginal(time_fn, n_lo, n_hi, label)
+        except InvalidMeasurement as exc:
+            last = exc
+            if i + 1 < attempts:
+                print(
+                    f"{label}: resampling after noise gate "
+                    f"({i + 1}/{attempts})",
+                    file=sys.stderr,
+                )
+    raise last
+
+
 def main() -> int:
     import numpy as np
 
@@ -181,7 +202,7 @@ def main() -> int:
         if alive != STEADY_512[n % 2]:
             print(f"STEADY-STATE FAILURE at {n}: {alive}", file=sys.stderr)
             return 1
-    per_turn, det = marginal(evolve, n_lo, n_hi, "c3_512_pallas_bitboard")
+    per_turn, det = gated(evolve, n_lo, n_hi, "c3_512_pallas_bitboard")
     headline = 512 * 512 / per_turn
     extra["c3_512_pallas_bitboard"] = dict(det, cell_updates_per_s=round(headline))
 
@@ -202,7 +223,7 @@ def main() -> int:
         print(f"ENGINE PARITY FAILURE: {alive}", file=sys.stderr)
         return 1
     engine_run(n_lo), engine_run(n_hi)  # warm both endpoint shapes
-    eng_per_turn, eng_det = marginal(engine_run, n_lo, n_hi, "c3_512_engine_driven")
+    eng_per_turn, eng_det = gated(engine_run, n_lo, n_hi, "c3_512_engine_driven")
     extra["c3_512_engine_driven"] = dict(
         eng_det,
         cell_updates_per_s=round(512 * 512 / eng_per_turn),
@@ -223,7 +244,7 @@ def main() -> int:
         return 1
     print("parity 128^2 ok (1000 turns vs numpy oracle)", file=sys.stderr)
     evolve128(n_lo), evolve128(n_hi)
-    pt128, det128 = marginal(evolve128, n_lo, n_hi, "c2_128_pallas_bitboard")
+    pt128, det128 = gated(evolve128, n_lo, n_hi, "c2_128_pallas_bitboard")
     extra["c2_128_pallas_bitboard"] = dict(
         det128,
         cell_updates_per_s=round(128 * 128 / pt128),
@@ -258,7 +279,7 @@ def main() -> int:
     # round-trip noise spikes must be dominated 5x for the fit to publish
     n4_lo, n4_hi = 2_000, 62_000
     evolve4k(n4_lo), evolve4k(n4_hi)
-    pt4k, det4k = marginal(evolve4k, n4_lo, n4_hi, "c4_4096_tiled_bitboard")
+    pt4k, det4k = gated(evolve4k, n4_lo, n4_hi, "c4_4096_tiled_bitboard")
     extra["c4_4096_tiled_bitboard"] = dict(
         det4k, cell_updates_per_s=round(4096 * 4096 / pt4k)
     )
@@ -273,20 +294,30 @@ def main() -> int:
     from gol_distributed_final_tpu.parallel import make_mesh
     from gol_distributed_final_tpu.parallel.bit_halo import ShardedBitPlane
 
+    # depth 8 is the SECOND role of wide halos (r5 finding): the
+    # tile-aligned ext is built once per 8 turns, amortising its HBM
+    # materialisation 8-fold even where collective latency is free — on
+    # chip, depth 8 at 512^2 measured ~2x over depth 1
     mesh11 = make_mesh((1, 1), devices=[dev])
-    for size, src, raw_pt, key in (
-        (512, board, per_turn, "c6_512_mesh_tax"),
-        (4096, b4k, pt4k, "c6_4096_mesh_tax"),
+    want_cache = {}  # per-size 96-turn reference: both depths share it
+    for size, src, raw_pt, depth, key in (
+        (512, board, per_turn, 1, "c6_512_mesh_tax"),
+        (4096, b4k, pt4k, 1, "c6_4096_mesh_tax"),
+        (512, board, per_turn, 8, "c6_512_mesh_tax_wide8"),
+        (4096, b4k, pt4k, 8, "c6_4096_mesh_tax_wide8"),
     ):
-        mplane = ShardedBitPlane(mesh11, CONWAY, word_axis)
+        mplane = ShardedBitPlane(mesh11, CONWAY, word_axis, halo_depth=depth)
         mstate = mplane.encode(src)
         # parity vs the single-chip plane, on-device array equality
-        want_m = plane.step_n(plane.encode(src), 100)
-        got_m = mplane.step_n(mstate, 100)
+        # (96 = 12 wide iterations at depth 8, no remainder)
+        if size not in want_cache:
+            want_cache[size] = plane.step_n(plane.encode(src), 96)
+        want_m = want_cache[size]
+        got_m = mplane.step_n(mstate, 96)
         if not np.array_equal(np.asarray(got_m), np.asarray(want_m)):
-            print(f"PARITY FAILURE {size}^2 mesh vs plane", file=sys.stderr)
+            print(f"PARITY FAILURE {size}^2 mesh d{depth}", file=sys.stderr)
             return 1
-        print(f"parity {size}^2 mesh(1,1) ok (100 turns)", file=sys.stderr)
+        print(f"parity {size}^2 mesh(1,1) d{depth} ok (96 turns)", file=sys.stderr)
 
         def evolve_mesh(n, mplane=mplane, mstate=mstate):
             return bitpack.alive_count_packed(mplane.step_n(mstate, n))
@@ -295,7 +326,7 @@ def main() -> int:
         # work dominates tunnel noise 5x even if the tax is large
         n6_lo, n6_hi = (20_000, 420_000) if size == 512 else (2_000, 62_000)
         evolve_mesh(n6_lo), evolve_mesh(n6_hi)
-        pt_mesh, det_mesh = marginal(evolve_mesh, n6_lo, n6_hi, key)
+        pt_mesh, det_mesh = gated(evolve_mesh, n6_lo, n6_hi, key)
         extra[key] = dict(
             det_mesh,
             cell_updates_per_s=round(size * size / pt_mesh),
@@ -326,7 +357,7 @@ def main() -> int:
 
         n5_lo, n5_hi = (2_000, 22_000) if size == 16384 else (500, 3_500)
         evolve_big(n5_lo), evolve_big(n5_hi)
-        pt_big, det_big = marginal(evolve_big, n5_lo, n5_hi, key)
+        pt_big, det_big = gated(evolve_big, n5_lo, n5_hi, key)
         extra[key] = dict(det_big, cell_updates_per_s=round(size * size / pt_big))
         # drop BOTH references (the closure's default-arg binding keeps the
         # device buffer alive otherwise) so the 512 MiB frees between sizes

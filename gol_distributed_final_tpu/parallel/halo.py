@@ -53,7 +53,10 @@ def _exchange(block, axis_name: str, n: int, dim: int, pad: int = 0, k: int = 1)
     the number of collective latencies per turn by k at identical traffic
     volume (k slices every k turns) — the lever that matters when the
     mesh axis crosses DCN, where per-collective latency, not bandwidth,
-    bounds scaling.
+    bounds scaling. It is ALSO the ext-amortisation lever where latency
+    is free (single host, ICI): the extended block is materialised once
+    per k turns instead of every turn — on chip, depth 8 at 512^2
+    measured 2x over depth 1 on the pallas route (r5).
 
     ``pad`` adds that many ZERO slices outside each halo, fused into the
     same concatenate: the pallas local step (parallel/bit_halo.py) needs a
