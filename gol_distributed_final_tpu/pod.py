@@ -291,11 +291,20 @@ class _PodControl:
             count = alive_count_packed(state)
             if self.is_root and not self.paused:
                 self.events.put(AliveCellsCount(turn, count))
-        if word & _CTL_SNAPSHOT:
+        if word & (_CTL_SNAPSHOT | _CTL_DETACH):
+            # 's' streams on demand; 'q' streams the CURRENT state before
+            # the controller surface closes — the reference's q handler
+            # writes the PGM first (gol/distributor.go:63-77), and for a
+            # detached run this snapshot is the only on-disk copy until
+            # the completed final board overwrites it. 'k' needs no gate
+            # write: the closing sequence's unconditional stream IS the
+            # killed-at state (a second identical 4 GiB collective write
+            # at 65536^2 would be pure waste). One stream even when both
+            # bits land in the same word.
             stream_packed_to_pgm_sharded(
                 self.out_path, state, self.word_axis, self.row_block
             )
-            if self.is_root:
+            if self.is_root and word & _CTL_SNAPSHOT:
                 print(self.params.output_filename)
         if self.is_root and self._pause_pairs:
             # toggle-pairs cancelled at this gate: the state never changed,

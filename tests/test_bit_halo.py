@@ -388,6 +388,62 @@ class TestWideHalos:
         # ...and constructing with auto routing + deep halo must not raise
         sharded_bit_step_n_fn(make_mesh((2, 4)), halo_depth=9)
 
+    @requires_8
+    def test_rule_depth_route_composition_property(self):
+        """Property: for ANY B/S rule, any halo depth 1..4, and either
+        local-step route (XLA / interpreted pallas), the mesh evolution
+        equals the single-device bitboard under the same rule — the three
+        knobs must compose for the whole rule space, not just Conway
+        (extends test_bitpack's rule-space property onto the mesh)."""
+        from hypothesis import given, settings
+        from hypothesis import strategies as st
+
+        from gol_distributed_final_tpu.models import LifeRule
+        from gol_distributed_final_tpu.parallel.bit_halo import (
+            packed_sharding,
+            sharded_bit_step_n_fn,
+        )
+
+        mesh = make_mesh((2, 4))
+        rng = np.random.default_rng(40)
+        board = np.where(rng.random((512, 512)) < 0.35, 255, 0).astype(np.uint8)
+        host_packed = bitpack.pack(board, 0)
+        packed = jax.device_put(host_packed, packed_sharding(mesh))
+
+        @settings(max_examples=10, deadline=None)
+        @given(
+            birth=st.sets(st.integers(0, 8)),
+            survive=st.sets(st.integers(0, 8)),
+            depth=st.integers(1, 4),
+            use_pallas=st.booleans(),
+        )
+        def check(birth, survive, depth, use_pallas):
+            bmask = sum(1 << c for c in birth)
+            smask = sum(1 << c for c in survive)
+            rule = LifeRule(
+                f"B{''.join(map(str, sorted(birth)))}"
+                f"/S{''.join(map(str, sorted(survive)))}",
+                bmask, smask,
+            )
+            stepn = sharded_bit_step_n_fn(
+                mesh, rule,
+                pallas_local=use_pallas,
+                interpret=True if use_pallas else None,
+                halo_depth=depth,
+            )
+            n = depth + 1  # always exercises the remainder path
+            got = np.asarray(stepn(packed, n))
+            want = np.asarray(
+                bitpack.bit_step_n(host_packed, n, 0, bmask, smask)
+            )
+            np.testing.assert_array_equal(
+                got, want,
+                err_msg=f"B{sorted(birth)}/S{sorted(survive)} "
+                        f"depth={depth} pallas={use_pallas}",
+            )
+
+        check()
+
     @pytest.mark.parametrize("depth", [2, 3])
     def test_wide_pod_session_golden(self, depth, tmp_path):
         """The knob through the full pod surface: a wide-halo session's

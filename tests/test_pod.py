@@ -266,6 +266,60 @@ def test_pod_keys_behind_q_are_not_consulted(tmp_path):
     assert res.turns_completed == TURNS
 
 
+def test_pod_k_output_holds_killed_state(tmp_path):
+    """After 'k', the session PGM holds the board AS OF the kill turn —
+    the reference's write-before-SuperQuit contract
+    (gol/distributor.go:92-106), delivered here by the closing sequence's
+    stream of the final (killed-at) state."""
+    board = _random_board(11)
+    in_path = tmp_path / f"{SIZE}x{SIZE}.pgm"
+    _write_pgm(in_path, board)
+    keys = queue.Queue()
+    keys.put("k")
+    res = pod_session(
+        SIZE, 1_000_000, make_mesh((2, 4)), in_path=in_path,
+        events=queue.Queue(), keypresses=keys, tick_seconds=3600,
+        out_dir=tmp_path / "out", min_chunk=2, max_chunk=2,
+    )
+    assert res.turns_completed == 2
+    got = (tmp_path / "out" / f"{SIZE}x{SIZE}x1000000.pgm").read_bytes()
+    want = _oracle(board, 2)
+    assert got == b"P5\n%d %d\n255\n" % (SIZE, SIZE) + want.tobytes()
+
+
+def test_pod_q_streams_snapshot_at_detach_gate(tmp_path, monkeypatch):
+    """'q' streams the CURRENT state at the detach gate (the reference's
+    write-before-quit, gol/distributor.go:63-77) — for a detached run
+    this is the only on-disk copy until completion overwrites it. Pinned
+    by recording the stream calls: one at the gate with the turn-2 board,
+    one from the closing sequence with the final board."""
+    import gol_distributed_final_tpu.pod as pod_mod
+
+    board = _random_board(12)
+    in_path = tmp_path / f"{SIZE}x{SIZE}.pgm"
+    _write_pgm(in_path, board)
+    streams = []
+    real = pod_mod.stream_packed_to_pgm_sharded
+
+    def spy(path, state, word_axis, row_block):
+        from gol_distributed_final_tpu.ops.bitpack import unpack
+        streams.append(unpack(np.asarray(state), word_axis))
+        return real(path, state, word_axis, row_block)
+
+    monkeypatch.setattr(pod_mod, "stream_packed_to_pgm_sharded", spy)
+    keys = queue.Queue()
+    keys.put("q")
+    res = pod_session(
+        SIZE, TURNS, make_mesh((2, 4)), in_path=in_path,
+        events=queue.Queue(), keypresses=keys, tick_seconds=3600,
+        out_dir=tmp_path / "out", min_chunk=2, max_chunk=2,
+    )
+    assert res.turns_completed == TURNS
+    assert len(streams) == 2, f"{len(streams)} stream calls"
+    np.testing.assert_array_equal(streams[0], _oracle(board, 2))
+    np.testing.assert_array_equal(streams[1], _oracle(board, TURNS))
+
+
 def test_pod_rejects_depth_too_deep_for_blocks(tmp_path):
     """A board whose packed layout cannot carry the requested halo depth
     fails at session entry with an error naming the knob — not hours in
