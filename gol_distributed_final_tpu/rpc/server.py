@@ -77,11 +77,21 @@ class RpcServer:
         with self._inflight_cv:
             self._inflight += 1
         try:
-            call_id, method, request = msg["id"], msg["method"], msg["request"]
-            try:
-                fn = self._methods[method]
-            except KeyError:
-                reply = {"id": call_id, "error": f"unknown method: {method}"}
+            # anything can be missing or of the wrong type in a frame that
+            # deserialised through the allowlist (plain lists/dicts are
+            # reachable): every malformed shape gets DEFINED behavior — an
+            # error reply whenever the frame named a call id (a client is
+            # identifiably waiting, RpcClient.call blocks without timeout),
+            # a silent skip only when no id is recoverable
+            envelope = msg if isinstance(msg, dict) else {}
+            call_id = envelope.get("id")
+            if call_id is None:
+                return  # not a call envelope: no reply is owed
+            method = envelope.get("method")
+            request = envelope.get("request")
+            fn = self._methods.get(method) if isinstance(method, str) else None
+            if fn is None:
+                reply = {"id": call_id, "error": f"unknown method: {method!r}"}
             else:
                 try:
                     reply = {"id": call_id, "result": fn(request)}

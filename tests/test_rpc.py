@@ -450,6 +450,45 @@ def test_restricted_unpickler_rejects_forbidden_globals():
         np.testing.assert_array_equal(out["request"].world, req.world)
 
 
+def test_malformed_envelopes_get_defined_behavior(tmp_path):
+    """Frames that deserialise through the allowlist but are not proper
+    call envelopes get DEFINED behavior: an ERROR REPLY whenever an id is
+    present (an identified client is blocking on it), a silent skip when
+    none is recoverable — and never an uncaught thread exception (the
+    broker process must emit no traceback)."""
+    import socket
+
+    from gol_distributed_final_tpu.rpc.protocol import recv_frame, send_frame
+
+    broker = _spawn("gol_distributed_final_tpu.rpc.broker", "-port", "0")
+    try:
+        port = _wait_listening(broker)
+        s = socket.create_connection(("127.0.0.1", port), timeout=30)
+        try:
+            send_frame(s, ["not", "an", "envelope"])  # no id: no reply owed
+            send_frame(s, {"id": 5, "method": {}, "request": None})
+            reply = recv_frame(s)
+            assert reply["id"] == 5 and "unknown method" in reply["error"]
+            send_frame(s, {"id": 6, "method": Methods.RETRIEVE})  # no request
+            reply = recv_frame(s)
+            assert reply["id"] == 6 and "error" in reply
+            # the same connection still serves a real call
+            send_frame(
+                s,
+                {"id": 7, "method": Methods.RETRIEVE,
+                 "request": Request(include_world=False)},
+            )
+            reply = recv_frame(s)
+            assert reply["id"] == 7 and ("result" in reply or "error" in reply)
+        finally:
+            s.close()
+    finally:
+        if broker.poll() is None:
+            broker.kill()
+        out, _ = broker.communicate(timeout=30)
+    assert "Traceback" not in out, f"uncaught exception in broker:\n{out}"
+
+
 def test_server_drops_connection_on_malicious_frame(tmp_path):
     """A forbidden frame kills only that connection; the server keeps
     serving honest peers, and the payload is never executed."""
