@@ -58,6 +58,7 @@ class TpuBackend:
         key = (height, width, rule.rulestring, halo_depth)
         if key not in self._planes:
             plane = None
+            mesh_built = False
             if self._use_mesh:
                 import jax
 
@@ -68,6 +69,7 @@ class TpuBackend:
                 if len(jax.devices()) > 1:
                     try:
                         mesh = make_mesh(height=height, width=width)
+                        mesh_built = True
                         nrows, ncols = (
                             mesh.shape["rows"], mesh.shape["cols"],
                         )
@@ -91,10 +93,14 @@ class TpuBackend:
                             )
                     except ValueError:
                         pass  # indivisible board: single-device engine
-            if plane is None and halo_depth > 1:
-                # the knob cannot be honored at all (single device, or a
-                # board smaller than the depth on every mesh plane):
-                # refuse loudly rather than silently running at depth 1
+            if plane is None and halo_depth > 1 and mesh_built:
+                # a mesh was BUILT but no plane supports this depth (the
+                # board is smaller than the depth everywhere): refuse
+                # loudly rather than silently running at depth 1. When no
+                # mesh exists at all — one chip, or an indivisible board —
+                # the run lands on the single-device engine with ZERO halo
+                # exchanges, so the knob is vacuous, not dishonored: a
+                # cluster-wide -halo-depth flag must not fail those runs.
                 raise ValueError(
                     f"halo_depth {halo_depth} cannot be honored for "
                     f"{width}x{height} on this backend (no mesh plane "

@@ -199,6 +199,36 @@ def test_request_halo_depth_rides_the_wire(tmp_path):
         broker.wait()
 
 
+def test_halo_depth_vacuous_on_single_device_but_refused_when_too_deep():
+    """A cluster-wide -halo-depth flag must not fail runs landing on a
+    one-chip node: with no mesh there are no halo exchanges, so the knob
+    is vacuous, not dishonored. But when a mesh EXISTS and no plane can
+    carry the depth (board smaller than the depth everywhere), the
+    backend refuses loudly rather than silently running at depth 1."""
+    from gol_distributed_final_tpu.io.pgm import read_board
+    from gol_distributed_final_tpu.rpc.broker import TpuBackend
+
+    board = read_board(
+        Params(turns=4, image_width=16, image_height=16), REPO_ROOT / "images"
+    )
+    req = Request(world=board, turns=4, image_width=16, image_height=16)
+    # single-device node (use_mesh=False models it): vacuous-accept
+    single = TpuBackend(use_mesh=False, halo_depth=2)
+    res = single.run(req)
+    assert res.turns_completed == 4
+    # an INDIVISIBLE board (no mesh shape divides 17) also runs on the
+    # single-device engine — zero halo exchanges, equally vacuous
+    odd = np.zeros((17, 17), np.uint8)
+    res = TpuBackend(halo_depth=2).run(
+        Request(world=odd, turns=2, image_width=17, image_height=17)
+    )
+    assert res.turns_completed == 2
+    # 8-device mesh, depth deeper than any plane's blocks: loud refusal
+    deep = TpuBackend(halo_depth=16)
+    with pytest.raises(ValueError, match="cannot be honored"):
+        deep.run(req)
+
+
 def test_halo_depth_requires_mesh_broker(tmp_path):
     """run(halo_depth=N) without a remote broker is a clean ValueError
     (like a mismatched rule), not a TypeError mid-session — the knob
