@@ -96,9 +96,12 @@ def main() -> int:
         assert int(data["num_processes"]) == num_procs
 
     # phase 2: resume from turn 16 in a fresh engine — WITH wide halos
-    # (halo_depth=2: two turns per exchange, the ppermutes crossing the
-    # process boundary carry 2-deep halos), so resume x temporal blocking
-    # is proven cross-host; the end must still be byte-identical
+    # (halo_depth=4: with 4 turns remaining and chunk=4, each dispatch is
+    # EXACTLY one wide iteration — n // depth = 1, no single-step
+    # remainder — so a genuine 4-deep halo ppermute crosses the process
+    # boundary; a deeper setting would silently fall into the remainder
+    # path and exercise nothing wide). Resume x temporal blocking proven
+    # cross-host; the end must still be byte-identical.
     res2 = pod_session(
         size,
         turns,
@@ -109,7 +112,7 @@ def main() -> int:
         out_dir=tmpdir / "out2",
         min_chunk=4,
         max_chunk=4,
-        halo_depth=2,
+        halo_depth=4,
     )
     assert res2.turns_completed == turns
 

@@ -298,6 +298,32 @@ class TestWideHalos:
                 err_msg=f"depth={depth} n={n} word_axis={word_axis}",
             )
 
+    def test_packed_wide_beyond_pallas_bound(self):
+        """Depths past the pallas sublane bound (8) stay on the XLA local
+        step and remain exact: depth 9 at 1024^2 (blocks (16, 256) words,
+        so the halo is over half the block) vs the depth-1 path."""
+        import jax
+
+        from gol_distributed_final_tpu.parallel.bit_halo import (
+            packed_sharding,
+            sharded_bit_step_n_fn,
+        )
+
+        mesh = make_mesh((2, 4))
+        rng = np.random.default_rng(35)
+        packed = jax.device_put(
+            rng.integers(0, 1 << 32, (32, 1024), dtype=np.uint64)
+            .astype(np.uint32)
+            .view(np.int32),
+            packed_sharding(mesh),
+        )
+        base = sharded_bit_step_n_fn(mesh)
+        deep = sharded_bit_step_n_fn(mesh, halo_depth=9)
+        for n in (9, 10):
+            np.testing.assert_array_equal(
+                np.asarray(deep(packed, n)), np.asarray(base(packed, n))
+            )
+
     @pytest.mark.parametrize("depth", [2, 5])
     def test_byte_wide_matches_depth1(self, depth):
         from gol_distributed_final_tpu.parallel.halo import sharded_step_n_fn
