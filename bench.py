@@ -178,6 +178,14 @@ def main() -> int:
     print(f"bench device: {dev}", file=sys.stderr)
     extra = {}
 
+    # per-stage attribution rides along with every published number: the
+    # metrics registry (obs/) accumulates engine/dispatch/compile-cache
+    # timings across all configs and lands in extra["stage_timings"], so
+    # future rounds see WHERE the wall clock went, not just the totals
+    from gol_distributed_final_tpu.obs import metrics as obs_metrics
+
+    obs_metrics.enable()
+
     # ---- config 3 (headline): 512^2, pallas VMEM bitboard ----------------
     board = read_pgm("images/512x512.pgm")
     word_axis = 0  # rows packed: [H/32, W], lanes stay W wide
@@ -362,6 +370,12 @@ def main() -> int:
         # drop BOTH references (the closure's default-arg binding keeps the
         # device buffer alive otherwise) so the 512 MiB frees between sizes
         del evolve_big, state_big
+
+    # the RunReport's compact breakdown (obs/report.stage_timings): every
+    # nonzero histogram series as {count, sum_s, mean_s} + nonzero counters
+    from gol_distributed_final_tpu.obs.report import stage_timings
+
+    extra["stage_timings"] = stage_timings()
 
     print(
         json.dumps(

@@ -101,7 +101,23 @@ def main(argv=None) -> int:
         help="with -server: wide-halo depth for the broker's mesh planes "
              "(turns per halo exchange; 0 = the broker's default)",
     )
+    parser.add_argument(
+        "-metrics", action="store_true", default=False,
+        help="enable the metrics registry (obs/): engine, controller, and "
+             "RPC-client timings accumulate in-process at near-zero cost",
+    )
+    parser.add_argument(
+        "-report", action="store_true", default=False,
+        help="write out/report_<W>x<H>x<Turns>.json (metrics + device "
+             "inventory) at FinalTurnComplete; implies -metrics",
+    )
     args = parser.parse_args(argv)
+    if args.metrics or args.report:
+        # before any instrumented path runs, so the report sees the whole
+        # session (a -report without metrics would be an empty breakdown)
+        from .obs import metrics
+
+        metrics.enable()
     if args.halo_depth < 0:
         parser.error(
             f"-halo-depth must be >= 1 (or 0 for the broker's default), "
@@ -168,7 +184,7 @@ def main(argv=None) -> int:
         with trace_ctx:
             run(params, events, keypresses, broker=broker, rule=rule,
                 emit_flips=emit_flips, resume_from=args.resume,
-                halo_depth=args.halo_depth)
+                halo_depth=args.halo_depth, report=args.report)
     finally:
         consumer.join()
         restore_tty()

@@ -33,10 +33,26 @@ from ..events import (
 )
 from ..io.pgm import read_board, write_board
 from ..models import CONWAY
+from ..obs import instruments as _ins
+from ..obs import metrics as _metrics
 from .engine import Engine, EngineConfig, RunResult
 
 CLOSED = object()
 """Sentinel marking the end of an event stream (Go's close(events))."""
+
+
+def _emit(events: "queue.Queue", ev) -> None:
+    """events.put with per-event-type observability (obs/instruments.py):
+    emit latency + a count by event class. A flag check when metrics are
+    off — the CLOSED sentinel stays a raw put (it is stream plumbing,
+    not an event)."""
+    if not _metrics.enabled():
+        events.put(ev)
+        return
+    t0 = time.monotonic()
+    events.put(ev)
+    _ins.CONTROLLER_EMIT_SECONDS.observe(time.monotonic() - t0)
+    _ins.CONTROLLER_EVENTS_TOTAL.labels(type(ev).__name__).inc()
 
 
 def iter_events(q: "queue.Queue", timeout: float | None = None):
@@ -178,6 +194,9 @@ class _Ticker:
                 except queue.Empty:
                     key = None
             if key is not None:
+                # gated like every other site: metrics off = no clock
+                # reads, no label-child allocation
+                t_key = time.monotonic() if _metrics.enabled() else 0.0
                 try:
                     self._handle_key(key)
                 except Exception as exc:
@@ -185,6 +204,11 @@ class _Ticker:
                     # (e.g. a snapshot ValueError from an exotic broker):
                     # dying here silently kills the 2 s tick AND q/k/p
                     print(f"key '{key}' failed: {exc}")
+                finally:
+                    if t_key:
+                        _ins.CONTROLLER_KEY_SECONDS.labels(key).observe(
+                            time.monotonic() - t_key
+                        )
                 continue
             if time.monotonic() >= next_tick:
                 # re-anchor rather than increment: after a long keypress
@@ -193,6 +217,7 @@ class _Ticker:
                 next_tick = time.monotonic() + self.tick_seconds
                 # count-only snapshot: a device-side reduction, no full-board
                 # device->host copy on the tick path
+                t_tick = time.monotonic() if _metrics.enabled() else 0.0
                 try:
                     snap = self.broker.retrieve(include_world=False)
                 except Exception as exc:
@@ -200,10 +225,15 @@ class _Ticker:
                     # keypresses (including 'q') still need servicing
                     print(f"tick retrieve failed: {exc}")
                     continue
+                if t_tick:
+                    _ins.CONTROLLER_TICK_SECONDS.observe(
+                        time.monotonic() - t_tick
+                    )
                 self._last_turn = snap.turns_completed
                 if not self.paused and not self.done.is_set():
-                    self.events.put(
-                        AliveCellsCount(snap.turns_completed, snap.alive_count)
+                    _emit(
+                        self.events,
+                        AliveCellsCount(snap.turns_completed, snap.alive_count),
                     )
                 continue
             time.sleep(self._POLL)
@@ -212,7 +242,7 @@ class _Ticker:
         # gol/distributor.go:61-122
         if key == "q":
             turn = self._try_snapshot_turn()
-            self.events.put(StateChange(turn, Quitting))
+            _emit(self.events, StateChange(turn, Quitting))
             self.done.set()
             self.broker.quit()
         elif key == "s":
@@ -220,7 +250,7 @@ class _Ticker:
             self._snapshot_to_pgm()
         elif key == "k":
             turn = self._try_snapshot_turn()
-            self.events.put(StateChange(turn, Quitting))
+            _emit(self.events, StateChange(turn, Quitting))
             self.done.set()
             self.broker.super_quit()
         elif key == "p":
@@ -231,14 +261,15 @@ class _Ticker:
             # the printed state and the engine state silently disagree
             if not self.paused:
                 self.broker.pause()
-                self.events.put(StateChange(snap.turns_completed, State.PAUSED))
+                _emit(self.events, StateChange(snap.turns_completed, State.PAUSED))
                 self.paused = True
             else:
                 self.broker.pause()
                 # the reference reports one turn fewer on resume
                 # (gol/distributor.go:118) — preserved for parity
-                self.events.put(
-                    StateChange(snap.turns_completed - 1, State.EXECUTING)
+                _emit(
+                    self.events,
+                    StateChange(snap.turns_completed - 1, State.EXECUTING),
                 )
                 self.paused = False
 
@@ -257,6 +288,7 @@ def run(
     tick_seconds: float = 2.0,
     resume_from=None,
     halo_depth: int = 0,
+    report: bool = False,
 ) -> RunResult:
     """Run a full Game of Life session (gol.Run + distributor, gol/gol.go:12).
 
@@ -274,6 +306,12 @@ def run(
     ``halo_depth`` (0 = backend default) ships the wide-halo depth to a
     remote broker's mesh planes — the DCN lever on the session surface
     (VERDICT r4 item 5). Only meaningful with ``broker=``.
+
+    ``report`` writes a RunReport (obs/report.py: the metrics registry +
+    device inventory) to ``out_dir/report_<W>x<H>x<Turns>.json`` at
+    ``FinalTurnComplete`` — the ``-report`` CLI flag. The registry must be
+    enabled (``obs.metrics.enable()``; the flag does it) for the report to
+    carry timings; a report failure is printed, never fatal.
     """
     initial_turn = 0
     ckpt_rule = None
@@ -310,6 +348,7 @@ def run(
         broker = InProcessBroker(Engine(engine_config))
 
     ticker = None
+    t_session = time.monotonic()
     try:
         world = ckpt_world if resume_from is not None else read_board(params, images_dir)
         ticker = _Ticker(params, events, keypresses, broker, out_dir, tick_seconds)
@@ -356,12 +395,28 @@ def run(
                 "the session contract writes the final PGM from the world; "
                 "a final_world=False engine belongs to the bigboard surface"
             )
-        events.put(FinalTurnComplete(result.turns_completed, result.alive))
+        _emit(events, FinalTurnComplete(result.turns_completed, result.alive))
+        if report:
+            # the run's attribution artifact, dumped at FinalTurnComplete;
+            # a failed dump must not fail the session it describes
+            try:
+                from ..obs.report import write_run_report
+
+                path = write_run_report(
+                    params,
+                    out_dir,
+                    wall_seconds=time.monotonic() - t_session,
+                    extra={"turns_completed": result.turns_completed},
+                )
+                print(f"run report written to {path}")
+            except Exception as exc:
+                print(f"run report failed: {exc}")
         write_board(result.world, params.output_filename, out_dir)
-        events.put(
-            ImageOutputComplete(result.turns_completed, params.output_filename)
+        _emit(
+            events,
+            ImageOutputComplete(result.turns_completed, params.output_filename),
         )
-        events.put(StateChange(result.turns_completed, Quitting))
+        _emit(events, StateChange(result.turns_completed, Quitting))
         return result
     finally:
         if ticker is not None:

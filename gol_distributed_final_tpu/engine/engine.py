@@ -38,6 +38,8 @@ _PIPELINE_DEPTH = 3
 
 from ..events import CellFlipped, TurnComplete
 from ..models import CONWAY, LifeRule
+from ..obs import instruments as _ins
+from ..obs import metrics as _metrics
 from ..ops import alive_cells
 from ..utils.cell import Cell
 
@@ -81,13 +83,15 @@ class RunResult:
         alive: Optional[List[Cell]] = None,
         state=None,
         plane=None,
-        checkpoint_error: Optional[OSError] = None,
+        checkpoint_error: Optional[Exception] = None,
     ):
         self.turns_completed = turns_completed
         self.world = world
-        # non-fatal: the last periodic-checkpoint IO failure, if any — the
-        # run itself completed (a disk-full must not abort the multi-hour
-        # run checkpointing exists to protect; ADVICE.md round 3)
+        # non-fatal: the last periodic-checkpoint failure of ANY type, not
+        # just OSError — the run itself completed (a disk-full must not
+        # abort the multi-hour run checkpointing exists to protect,
+        # ADVICE.md round 3; and a non-OSError shard-write failure must
+        # take the same continue path on every rank, ADVICE r5)
         self.checkpoint_error = checkpoint_error
         self._alive = alive
         self._state = state
@@ -325,13 +329,20 @@ class Engine:
             # keeps retrieve latency <= depth x target_dispatch_seconds.
             inflight: deque = deque()
             growth_done = False  # doubling ended (max_chunk OR slow dispatch)
-            ckpt_error: OSError | None = None
+            ckpt_error: Exception | None = None
             while True:
                 with self._lock:
-                    while self._paused and not self._quit:
-                        self._parked = True
-                        self._control.notify_all()
-                        self._control.wait()
+                    if self._paused and not self._quit:
+                        # the park gate, timed: how long control traffic
+                        # held the data plane still (obs/instruments.py)
+                        t_park = time.monotonic()
+                        while self._paused and not self._quit:
+                            self._parked = True
+                            self._control.notify_all()
+                            self._control.wait()
+                        _ins.ENGINE_PARK_SECONDS.observe(
+                            time.monotonic() - t_park
+                        )
                     self._parked = False
                     if self._quit or self._turn >= params.turns:
                         break
@@ -352,6 +363,17 @@ class Engine:
                     if len(inflight) > _PIPELINE_DEPTH:
                         inflight.popleft().block_until_ready()
                 elapsed = time.monotonic() - t0
+                if _metrics.enabled():
+                    # per-turn attribution (obs/): dispatch wall spread over
+                    # the chunk's turns, so the step histogram's COUNT is
+                    # the turn count (growth chunks are synchronous and
+                    # accurate; pipelined chunks record enqueue time — the
+                    # device-side truth lives in the jax.profiler trace)
+                    _ins.ENGINE_DISPATCH_SECONDS.observe(elapsed)
+                    _ins.ENGINE_STEP_SECONDS.observe_n(elapsed / n, n)
+                    _ins.ENGINE_TURNS_TOTAL.inc(n)
+                    _ins.ENGINE_CHUNKS_TOTAL.inc()
+                    _ins.ENGINE_CHUNK_SIZE.set(chunk)
                 if growing:
                     if multihost:
                         # the wall-clock cap is rank-local: unagreed it
@@ -411,16 +433,29 @@ class Engine:
 
                 every = self.config.checkpoint_every
                 if every and turn_now // every > (turn_now - n) // every:
+                    t_ckpt = time.monotonic()
                     try:
                         self._write_checkpoint(new_state, turn_now)
-                    except OSError as exc:
-                        # a full disk must not abort the multi-hour run
-                        # this checkpoint exists to protect; the failure is
-                        # surfaced on the RunResult (ADVICE.md round 3)
+                    except Exception as exc:
+                        # catch EVERYTHING, not just OSError: a full disk
+                        # must not abort the multi-hour run this checkpoint
+                        # exists to protect (ADVICE.md round 3) — and in an
+                        # SPMD job the write can fail with ANY exception
+                        # type (a pickling error, a shard-shape bug). Were
+                        # only OSError caught, the raising rank would abort
+                        # while its peers continue and hang at the next
+                        # collective; _write_checkpoint's multihost path
+                        # agrees the failure via allgather, so this broad
+                        # catch makes every rank take the SAME continue
+                        # decision (ADVICE r5). Surfaced on the RunResult.
                         ckpt_error = exc
+                        _ins.ENGINE_CHECKPOINT_ERRORS_TOTAL.inc()
                         print(
                             f"checkpoint at turn {turn_now} failed: {exc}"
                         )
+                    _ins.ENGINE_CHECKPOINT_SECONDS.observe(
+                        time.monotonic() - t_ckpt
+                    )
 
             with self._lock:
                 turns_done = self._turn

@@ -1,0 +1,30 @@
+"""Observability — the metrics/report layer the perf work attributes to.
+
+The reference's only runtime signals are the 2-second ``AliveCellsCount``
+tick and a ``runtime/trace`` test wrapper (count_test.go, trace_test.go);
+our port adds the ``jax.profiler`` shim in ``utils/trace.py``. Neither says
+*where* a run's wall clock goes — dispatch vs. halo exchange vs. host
+transfer vs. RPC — which is the first question every perf round asks
+(BENCH_r*.json measures only end-to-end time).
+
+This package is the answer, in three parts:
+
+* ``metrics``     — a dependency-free registry (counters, gauges,
+                    fixed-bucket histograms) with JSON and Prometheus-text
+                    exposition and EXACT cross-host merge;
+* ``instruments`` — the single declaration site for every metric the
+                    codebase records (engine, controller, RPC, ops,
+                    parallel) — the stable-name contract the README
+                    documents and ``lint`` enforces;
+* ``report``      — the ``RunReport`` writer (registry + device inventory
+                    + memory stats -> ``out/report_<W>x<H>x<Turns>.json``)
+                    and the ``Status`` RPC payload builder.
+
+Everything is process-local and OFF by default: with metrics disabled each
+instrument call is a flag check, so the hot paths cost nothing until an
+operator passes ``-metrics``/``-report`` (or calls ``metrics.enable()``).
+The complementary device-side view — per-dispatch timelines, compiles,
+transfers — stays with ``utils/trace.py``'s ``jax.profiler`` trace.
+"""
+
+from . import metrics  # noqa: F401

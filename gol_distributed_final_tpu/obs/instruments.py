@@ -1,0 +1,168 @@
+"""Every metric the codebase records, declared in ONE place.
+
+The names are a stable operator-facing contract: they appear in RunReport
+JSON, in ``Status`` RPC payloads, and in Prometheus scrapes, so renaming
+one is a breaking change. The README's "Observability" table documents
+them all, and ``obs/lint.py`` (run by ``tests/test_obs.py``) fails the
+build if this module and that table drift apart.
+
+Conventions: seconds for every duration histogram (fixed DEFAULT_BUCKETS
+edges — the exact-merge contract), ``_total`` suffix on counters,
+``method``/``plane``/``site`` labels kept low-cardinality (RPC verb names,
+plane kinds, compile-cache sites — never per-board values).
+
+All instruments bind to the process-global default registry, which starts
+DISABLED: importing this module from a hot path costs nothing until an
+entry point calls ``metrics.enable()`` (the ``-metrics`` flags).
+"""
+
+from __future__ import annotations
+
+from .metrics import registry
+
+_R = registry()
+
+# -- engine run loop (engine/engine.py) -------------------------------------
+
+ENGINE_STEP_SECONDS = _R.histogram(
+    "gol_engine_step_seconds",
+    "Per-turn step time, dispatch wall / chunk turns (near-zero for "
+    "pipelined async chunks; growth-phase chunks are synchronous and "
+    "accurate). Count == turns evolved.",
+)
+ENGINE_DISPATCH_SECONDS = _R.histogram(
+    "gol_engine_dispatch_seconds",
+    "Per-chunk dispatch wall time (block_until_ready during chunk growth, "
+    "enqueue-only once pipelined).",
+)
+ENGINE_PARK_SECONDS = _R.histogram(
+    "gol_engine_park_seconds",
+    "Time the run loop spent parked in the pause gate, per park.",
+)
+ENGINE_CHECKPOINT_SECONDS = _R.histogram(
+    "gol_engine_checkpoint_seconds",
+    "Periodic checkpoint write time (including failed attempts).",
+)
+ENGINE_TURNS_TOTAL = _R.counter(
+    "gol_engine_turns_total", "Turns evolved by this process's engine."
+)
+ENGINE_CHUNKS_TOTAL = _R.counter(
+    "gol_engine_chunks_total", "Chunk dispatches issued by the run loop."
+)
+ENGINE_CHUNK_SIZE = _R.gauge(
+    "gol_engine_chunk_size", "Current turns-per-dispatch chunk size."
+)
+ENGINE_CHECKPOINT_ERRORS_TOTAL = _R.counter(
+    "gol_engine_checkpoint_errors_total",
+    "Periodic checkpoint attempts that failed (run continues).",
+)
+
+# -- controller / ticker (engine/controller.py) -----------------------------
+
+CONTROLLER_TICK_SECONDS = _R.histogram(
+    "gol_controller_tick_seconds",
+    "Ticker count-only retrieve latency (the 2 s AliveCellsCount path).",
+)
+CONTROLLER_KEY_SECONDS = _R.histogram(
+    "gol_controller_key_seconds",
+    "Keypress handling latency, per key.",
+    labelnames=("key",),
+)
+CONTROLLER_EMIT_SECONDS = _R.histogram(
+    "gol_controller_emit_seconds",
+    "Event-queue put latency on the controller's emit paths.",
+)
+CONTROLLER_EVENTS_TOTAL = _R.counter(
+    "gol_controller_events_total",
+    "Events emitted by the controller, by event type.",
+    labelnames=("event",),
+)
+
+# -- RPC, both sides (rpc/client.py, rpc/server.py) -------------------------
+
+RPC_CLIENT_REQUESTS_TOTAL = _R.counter(
+    "gol_rpc_client_requests_total",
+    "Outbound RPC calls issued, by verb.",
+    labelnames=("method",),
+)
+RPC_CLIENT_ERRORS_TOTAL = _R.counter(
+    "gol_rpc_client_errors_total",
+    "Outbound RPC calls that raised RpcError, by verb.",
+    labelnames=("method",),
+)
+RPC_CLIENT_REQUEST_SECONDS = _R.histogram(
+    "gol_rpc_client_request_seconds",
+    "Outbound RPC round-trip latency (send to reply), by verb.",
+    labelnames=("method",),
+)
+RPC_CLIENT_SENT_BYTES_TOTAL = _R.counter(
+    "gol_rpc_client_sent_bytes_total",
+    "Request frame bytes (header + pickle payload) sent, by verb.",
+    labelnames=("method",),
+)
+RPC_CLIENT_RECEIVED_BYTES_TOTAL = _R.counter(
+    "gol_rpc_client_received_bytes_total",
+    "Reply frame bytes received, by verb.",
+    labelnames=("method",),
+)
+RPC_SERVER_REQUESTS_TOTAL = _R.counter(
+    "gol_rpc_server_requests_total",
+    "Inbound RPC calls dispatched, by verb.",
+    labelnames=("method",),
+)
+RPC_SERVER_ERRORS_TOTAL = _R.counter(
+    "gol_rpc_server_errors_total",
+    "Inbound RPC calls answered with an error reply, by verb.",
+    labelnames=("method",),
+)
+RPC_SERVER_REQUEST_SECONDS = _R.histogram(
+    "gol_rpc_server_request_seconds",
+    "Inbound RPC handler latency (dispatch to reply written), by verb.",
+    labelnames=("method",),
+)
+RPC_SERVER_RECEIVED_BYTES_TOTAL = _R.counter(
+    "gol_rpc_server_received_bytes_total",
+    "Request frame bytes received, by verb.",
+    labelnames=("method",),
+)
+RPC_SERVER_SENT_BYTES_TOTAL = _R.counter(
+    "gol_rpc_server_sent_bytes_total",
+    "Reply frame bytes sent, by verb.",
+    labelnames=("method",),
+)
+
+# -- kernel-tier selection + compile cache (ops/auto.py, parallel/*) --------
+
+OPS_PLANE_SELECTED_TOTAL = _R.counter(
+    "gol_ops_plane_selected_total",
+    "Automatic data-plane routing decisions, by selected tier "
+    "(bitplane / roll_stencil / pallas_bit_step / packed_xla_step).",
+    labelnames=("plane",),
+)
+COMPILE_CACHE_REQUESTS_TOTAL = _R.counter(
+    "gol_compile_cache_requests_total",
+    "Compiled-program cache lookups on the mesh step paths, by site.",
+    labelnames=("site",),
+)
+COMPILE_CACHE_MISSES_TOTAL = _R.counter(
+    "gol_compile_cache_misses_total",
+    "Cache lookups that traced+compiled a new program (hits = requests "
+    "- misses), by site.",
+    labelnames=("site",),
+)
+
+# -- halo-exchange data planes (parallel/halo.py, parallel/bit_halo.py) -----
+
+HALO_DISPATCH_SECONDS = _R.histogram(
+    "gol_halo_dispatch_seconds",
+    "Host-side wall time of one mesh step_n dispatch (trace/compile on "
+    "first call, enqueue after; device-side exchange time lives in the "
+    "jax.profiler trace), by plane.",
+    labelnames=("plane",),
+)
+HALO_EXCHANGES_TOTAL = _R.counter(
+    "gol_halo_exchanges_total",
+    "Halo exchanges (one rows+cols ppermute pair) issued inside mesh "
+    "dispatches, by plane.",
+    labelnames=("plane",),
+)

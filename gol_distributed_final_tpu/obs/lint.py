@@ -1,0 +1,54 @@
+"""Metric-name lint: the code's registry and the README's table must agree.
+
+The metric names in ``obs/instruments.py`` are a stable operator contract
+(they appear in RunReports, Status payloads, and Prometheus scrapes), and
+the README "Observability" section is their documentation of record. This
+lint fails when a name registered in code is missing from the README — so
+adding an instrument without documenting it breaks the build
+(``tests/test_obs.py`` runs it; ``python -m gol_distributed_final_tpu.obs.lint``
+runs it standalone).
+"""
+
+from __future__ import annotations
+
+import pathlib
+import sys
+from typing import List
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent.parent
+
+
+def undocumented_metrics(readme_path=None, histograms_only: bool = False) -> List[str]:
+    """Names registered in code but absent from the README text."""
+    from . import instruments  # noqa: F401 - registers every family
+    from .metrics import registry
+
+    if readme_path is None:
+        readme_path = REPO_ROOT / "README.md"
+    text = pathlib.Path(readme_path).read_text()
+    missing = []
+    for fam in registry().families():
+        if histograms_only and fam.kind != "histogram":
+            continue
+        if fam.name not in text:
+            missing.append(fam.name)
+    return sorted(missing)
+
+
+def main(argv=None) -> int:
+    missing = undocumented_metrics()
+    if missing:
+        print(
+            "metrics registered in obs/instruments.py but missing from "
+            "README.md's Observability table:",
+            file=sys.stderr,
+        )
+        for name in missing:
+            print(f"  {name}", file=sys.stderr)
+        return 1
+    print("metric-name lint ok: every registered metric is documented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,142 @@
+"""RunReport writer + Status payload builder.
+
+A RunReport is the per-run attribution artifact: the full metrics snapshot
+plus the device inventory (``jax.local_devices()``) and per-device memory
+stats, dumped to ``out/report_<W>x<H>x<Turns>.json`` when the controller
+reaches ``FinalTurnComplete``. BENCH rounds embed its compact
+``stage_timings`` so every published number carries its own breakdown
+(bench.py), instead of the ad-hoc timers earlier rounds hand-rolled.
+
+The Status payload is the same registry snapshot without the jax imports —
+served by the broker's and worker's read-only ``Status`` RPC verb, so an
+operator can interrogate a RUNNING process without disturbing it
+(``python -m gol_distributed_final_tpu.obs.status host:port``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import time
+from typing import Optional
+
+from . import metrics
+
+SCHEMA = "gol-run-report/1"
+
+
+def status_payload(**extra) -> dict:
+    """The ``Status`` verb's reply body: registry snapshot + identity.
+
+    Deliberately jax-free: a worker process that never imported jax must
+    answer Status without paying that import, and the verb must stay
+    cheap enough to poll."""
+    reg = metrics.registry()
+    payload = {
+        "schema": "gol-status/1",
+        "pid": os.getpid(),
+        "time_unix": time.time(),
+        "metrics_enabled": reg.enabled,
+        "metrics": reg.snapshot(),
+    }
+    payload.update(extra)
+    return payload
+
+
+def stage_timings(snap: Optional[dict] = None) -> dict:
+    """Compact per-stage attribution from a snapshot: every nonzero
+    histogram series as ``{count, sum_s, mean_s}`` and every nonzero
+    counter as its value, keyed ``name{label=value,...}``. The form BENCH
+    rounds embed (bench.py) — small enough to diff across rounds."""
+    if snap is None:
+        snap = metrics.registry().snapshot()
+    out: dict = {}
+    for fam in snap.get("families", []):
+        labelnames = fam.get("labelnames", [])
+        for s in fam["series"]:
+            pairs = ",".join(
+                f"{n}={v}" for n, v in zip(labelnames, s["labels"])
+            )
+            key = fam["name"] + (f"{{{pairs}}}" if pairs else "")
+            if fam["type"] == "histogram":
+                if s["count"]:
+                    out[key] = {
+                        "count": s["count"],
+                        "sum_s": round(s["sum"], 6),
+                        "mean_s": round(s["sum"] / s["count"], 9),
+                    }
+            elif s["value"]:
+                out[key] = s["value"]
+    return out
+
+
+def device_inventory() -> dict:
+    """``jax.local_devices()`` identity + per-device memory stats, each
+    guarded: a backend without memory_stats (CPU) reports null, and a
+    failing jax import degrades to an error note instead of sinking the
+    report that exists to explain the run."""
+    try:
+        import jax
+    except Exception as exc:  # pragma: no cover - jax is baked in
+        return {"error": f"jax unavailable: {exc}"}
+    devices = []
+    for dev in jax.local_devices():
+        entry = {
+            "id": dev.id,
+            "platform": dev.platform,
+            "device_kind": getattr(dev, "device_kind", ""),
+            "process_index": getattr(dev, "process_index", 0),
+        }
+        try:
+            entry["memory_stats"] = dev.memory_stats()
+        except Exception:
+            entry["memory_stats"] = None
+        devices.append(entry)
+    return {
+        "backend": devices[0]["platform"] if devices else "none",
+        "process_count": getattr(jax, "process_count", lambda: 1)(),
+        "local_devices": devices,
+    }
+
+
+def report_path(params, out_dir="out") -> pathlib.Path:
+    # rides the load-bearing <W>x<H>x<Turns> naming convention
+    # (params.output_filename, gol/distributor.go:165)
+    return pathlib.Path(out_dir) / f"report_{params.output_filename}.json"
+
+
+def write_run_report(
+    params,
+    out_dir="out",
+    *,
+    wall_seconds: Optional[float] = None,
+    extra: Optional[dict] = None,
+) -> pathlib.Path:
+    """Dump the registry + device inventory for a finished run. Written to
+    a temp name then renamed, like the checkpoint writer, so a crash
+    mid-dump never leaves a half-parseable report."""
+    snap = metrics.registry().snapshot()
+    report = {
+        "schema": SCHEMA,
+        "params": {
+            "image_width": params.image_width,
+            "image_height": params.image_height,
+            "turns": params.turns,
+            "threads": params.threads,
+        },
+        "time_unix": time.time(),
+        "wall_seconds": wall_seconds,
+        "metrics_enabled": metrics.enabled(),
+        "devices": device_inventory(),
+        "metrics": snap,
+        "stage_timings": stage_timings(snap),
+    }
+    if extra:
+        report.update(extra)
+    path = report_path(params, out_dir)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_name(path.name + ".tmp")
+    tmp.write_text(json.dumps(report, indent=1, default=str))
+    tmp.replace(path)
+    return path

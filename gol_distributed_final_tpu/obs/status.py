@@ -1,0 +1,76 @@
+"""Operator one-liner: snapshot a RUNNING broker or worker's metrics.
+
+    python -m gol_distributed_final_tpu.obs.status 127.0.0.1:8040
+    python -m gol_distributed_final_tpu.obs.status -worker 127.0.0.1:8030
+    python -m gol_distributed_final_tpu.obs.status -format prom :8040
+
+Read-only: the ``Status`` verb snapshots the server's registry under its
+lock and replies — it never touches the engine, the board, or the run
+loop, so polling it mid-run is safe (unlike ``RetrieveCurrentData``, whose
+full-world form costs a device->host transfer)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def fetch_status(address: str, worker: bool = False, timeout: float = 10.0) -> dict:
+    """One Status round-trip against a broker (default) or worker."""
+    from ..rpc.client import RpcClient
+    from ..rpc.protocol import Methods, Request
+
+    if address.startswith(":"):
+        address = "127.0.0.1" + address
+    client = RpcClient(address, timeout=timeout)
+    try:
+        # timeout bounds the REPLY wait too, not just the connect: a
+        # wedged server must fail this poller, never hang it
+        res = client.call(
+            Methods.WORKER_STATUS if worker else Methods.STATUS,
+            Request(),
+            timeout=timeout,
+        )
+    finally:
+        client.close()
+    # defensive: an older server's Response pickle predates the status
+    # field — surface "no status" rather than AttributeError
+    return getattr(res, "status", None) or {}
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="snapshot a running broker/worker's metrics registry"
+    )
+    parser.add_argument("address", help="host:port (or :port for loopback)")
+    parser.add_argument(
+        "-worker", action="store_true",
+        help="query a worker's GameOfLifeOperations.Status instead of the "
+             "broker's Operations.Status",
+    )
+    parser.add_argument(
+        "-format", choices=("json", "prom"), default="json",
+        help="json: the full status payload; prom: Prometheus text "
+             "exposition of the metrics snapshot",
+    )
+    args = parser.parse_args(argv)
+    try:
+        status = fetch_status(args.address, worker=args.worker)
+    except Exception as exc:
+        print(f"status fetch failed: {exc}", file=sys.stderr)
+        return 1
+    if not status:
+        print("server predates the Status verb (empty reply)", file=sys.stderr)
+        return 1
+    if args.format == "prom":
+        from .metrics import snapshot_to_prometheus
+
+        sys.stdout.write(snapshot_to_prometheus(status.get("metrics", {})))
+    else:
+        print(json.dumps(status, indent=1, default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,6 +16,8 @@ from typing import Callable, Optional
 
 import jax
 
+from ..obs import instruments as _ins
+
 
 def choose_word_axis(shape: tuple[int, int]) -> Optional[int]:
     """The single-device packed-layout policy: pack rows when H divides by
@@ -38,10 +40,14 @@ def auto_plane(rule, shape: tuple[int, int]):
     hot loop does no representation changes at all."""
     word_axis = choose_word_axis(shape)
     if word_axis is None:
+        # the caller falls back to the roll stencil; counted so a Status
+        # snapshot shows WHICH tier runs are landing on (obs/)
+        _ins.OPS_PLANE_SELECTED_TOTAL.labels("roll_stencil").inc()
         return None
 
     from .plane import BitPlane
 
+    _ins.OPS_PLANE_SELECTED_TOTAL.labels("bitplane").inc()
     return BitPlane(rule, word_axis)
 
 
@@ -52,13 +58,16 @@ def auto_step_n_fn(rule, shape: tuple[int, int]) -> Optional[Callable]:
     policy, kept for callers that want a plain step function."""
     word_axis = choose_word_axis(shape)
     if word_axis is None:
+        _ins.OPS_PLANE_SELECTED_TOTAL.labels("roll_stencil").inc()
         return None
 
     if jax.devices()[0].platform == "tpu":
         from .pallas_stencil import pallas_bit_step_n_fn
 
+        _ins.OPS_PLANE_SELECTED_TOTAL.labels("pallas_bit_step").inc()
         return pallas_bit_step_n_fn(word_axis=word_axis, interpret=False, rule=rule)
 
     from .bitpack import packed_step_n_fn
 
+    _ins.OPS_PLANE_SELECTED_TOTAL.labels("packed_xla_step").inc()
     return packed_step_n_fn(word_axis, rule=rule)

@@ -29,6 +29,7 @@ leaves its device (vs broker/broker.go:135-224's full-board reships).
 from __future__ import annotations
 
 import functools
+import time
 from typing import Callable, Optional
 
 import numpy as np
@@ -39,9 +40,17 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models import CONWAY, LifeRule
+from ..obs import instruments as _ins
+from ..obs import metrics as _metrics
 from ..ops.bitpack import WORD, bit_step, pack_device, unpack_device
-from .halo import _exchange, check_halo_depth, halo_depth_fits, wide_loop
-from .mesh import COLS, ROWS
+from .halo import (
+    _exchange,
+    check_halo_depth,
+    exchanges_per_dispatch,
+    halo_depth_fits,
+    wide_loop,
+)
+from .mesh import COLS, ROWS, shard_map_compat
 
 
 def choose_bit_layout(
@@ -270,13 +279,15 @@ def sharded_bit_step_n_fn(
 
     @functools.lru_cache(maxsize=None)
     def _compiled(n: int, use_pallas: bool):
+        # body runs only on a cache MISS: hits = requests - misses (obs/)
+        _ins.COMPILE_CACHE_MISSES_TOTAL.labels("halo.bit").inc()
         step = local_pallas if use_pallas else local
         wide_fn = wide_pallas if use_pallas else wide
 
         def local_n(block):
             return wide_loop(block, n, halo_depth, step, wide_fn)
 
-        sharded = jax.shard_map(
+        sharded = shard_map_compat(
             local_n,
             mesh=mesh,
             in_specs=P(ROWS, COLS),
@@ -313,7 +324,22 @@ def sharded_bit_step_n_fn(
                     f"pallas_local=True requires a sublane/lane-aligned "
                     f"local block; got {tuple(block_shape)}"
                 )
-        return _compiled(int(n), use_pallas)(packed)
+        if not _metrics.enabled():
+            return _compiled(int(n), use_pallas)(packed)
+        # host-side dispatch wall + exchange count, labelled by the local
+        # route actually taken (obs/); device-side exchange time lives in
+        # the profiler trace
+        plane_label = "bit_pallas" if use_pallas else "bit_xla"
+        _ins.COMPILE_CACHE_REQUESTS_TOTAL.labels("halo.bit").inc()
+        _ins.HALO_EXCHANGES_TOTAL.labels(plane_label).inc(
+            exchanges_per_dispatch(int(n), halo_depth)
+        )
+        t0 = time.monotonic()
+        out = _compiled(int(n), use_pallas)(packed)
+        _ins.HALO_DISPATCH_SECONDS.labels(plane_label).observe(
+            time.monotonic() - t0
+        )
+        return out
 
     return step_n
 

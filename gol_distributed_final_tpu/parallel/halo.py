@@ -19,6 +19,7 @@ have size 1 (a 1-D decomposition is just a degenerate 2-D one).
 from __future__ import annotations
 
 import functools
+import time
 from typing import Callable
 
 import jax
@@ -27,8 +28,20 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models import CONWAY, LifeRule
+from ..obs import instruments as _ins
+from ..obs import metrics as _metrics
 from ..ops.stencil import apply_rule, counts_from_extended
-from .mesh import COLS, ROWS
+from .mesh import COLS, ROWS, shard_map_compat
+
+
+def exchanges_per_dispatch(n: int, depth: int) -> int:
+    """Halo exchanges (rows+cols ppermute pairs) a ``wide_loop`` of ``n``
+    turns at ``halo_depth=depth`` issues — one per wide iteration plus one
+    per single-turn remainder. The obs counter's arithmetic, kept beside
+    ``wide_loop`` so the two cannot drift."""
+    if depth > 1:
+        return n // depth + n % depth
+    return n
 
 
 def board_sharding(mesh: Mesh) -> NamedSharding:
@@ -167,7 +180,7 @@ def sharded_step_fn(mesh: Mesh, rule: LifeRule = CONWAY) -> Callable:
     """
     mesh_shape = (mesh.shape[ROWS], mesh.shape[COLS])
     local = functools.partial(_local_step, rule=rule, mesh_shape=mesh_shape)
-    sharded = jax.shard_map(
+    sharded = shard_map_compat(
         local, mesh=mesh, in_specs=P(ROWS, COLS), out_specs=P(ROWS, COLS)
     )
     sharding = board_sharding(mesh)
@@ -200,10 +213,13 @@ def sharded_step_n_fn(
 
     @functools.lru_cache(maxsize=None)
     def _compiled(n: int):
+        # body runs only on a cache MISS: hits = requests - misses (obs/)
+        _ins.COMPILE_CACHE_MISSES_TOTAL.labels("halo.byte").inc()
+
         def local_n(block):
             return wide_loop(block, n, halo_depth, local, wide)
 
-        sharded = jax.shard_map(
+        sharded = shard_map_compat(
             local_n, mesh=mesh, in_specs=P(ROWS, COLS), out_specs=P(ROWS, COLS)
         )
         return jax.jit(sharded, in_shardings=sharding, out_shardings=sharding)
@@ -213,7 +229,21 @@ def sharded_step_n_fn(
             halo_depth,
             (board.shape[0] // mesh_shape[0], board.shape[1] // mesh_shape[1]),
         )
-        return _compiled(int(n))(board)
+        if not _metrics.enabled():
+            return _compiled(int(n))(board)
+        # host-side dispatch wall (compile on first call, enqueue after)
+        # + the exchange count this dispatch puts on the wire; the
+        # device-side exchange time itself lives in the profiler trace
+        _ins.COMPILE_CACHE_REQUESTS_TOTAL.labels("halo.byte").inc()
+        _ins.HALO_EXCHANGES_TOTAL.labels("byte").inc(
+            exchanges_per_dispatch(int(n), halo_depth)
+        )
+        t0 = time.monotonic()
+        out = _compiled(int(n))(board)
+        _ins.HALO_DISPATCH_SECONDS.labels("byte").observe(
+            time.monotonic() - t0
+        )
+        return out
 
     return step_n
 

@@ -70,3 +70,27 @@ def make_mesh(
     import numpy as np
 
     return Mesh(np.asarray(devices).reshape(r, c), (ROWS, COLS))
+
+
+def shard_map_compat(f, *, mesh: Mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` across jax versions — the ONE spelling every mesh
+    plane uses (halo.py, bit_halo.py).
+
+    Newer jax exposes ``jax.shard_map`` with the ``check_vma``
+    varying-mesh-axes checker; 0.4.x has only
+    ``jax.experimental.shard_map.shard_map`` whose ``check_rep`` plays the
+    same role (the replication checker the pallas local route must relax —
+    ADVICE.md round 3). Without this shim every mesh dispatch dies with
+    ``AttributeError: module 'jax' has no attribute 'shard_map'`` on 0.4.x
+    — 52 of the seed's 54 CPU-suite failures."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )

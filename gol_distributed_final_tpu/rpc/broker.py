@@ -128,18 +128,27 @@ class TpuBackend:
             image_height=req.image_height,
         )
         rule = self.engine.config.rule
-        if req.rulestring:
+        # EXTENSION fields are read via getattr throughout: a version-
+        # skewed older client's Request pickle simply lacks them, and an
+        # unconditional attribute read would turn that skew into an opaque
+        # AttributeError reply (ADVICE r5) — absent means "the default",
+        # exactly like the 0/"" in-band defaults of a current client
+        rulestring = getattr(req, "rulestring", "")
+        if rulestring:
             # a resumed checkpoint's rule travels on the wire; canonicalise
             # (case/whitespace) and honor it by picking the plane
             # explicitly instead of silently evolving under the default
             from ..models import LifeRule
 
-            rule = LifeRule.from_rulestring(req.rulestring)
+            rule = LifeRule.from_rulestring(rulestring)
         # 0 on the wire = "the server's default" (like rulestring's "")
-        depth = req.halo_depth if req.halo_depth else self._halo_depth
+        depth = getattr(req, "halo_depth", 0) or self._halo_depth
         plane = self._plane_for(req.image_height, req.image_width, rule, depth)
         return self.engine.run(
-            params, req.world, plane=plane, initial_turn=req.initial_turn
+            params,
+            req.world,
+            plane=plane,
+            initial_turn=getattr(req, "initial_turn", 0),
         )
 
     def pause(self):
@@ -191,7 +200,9 @@ class WorkersBackend:
     def run(self, req: Request) -> RunResult:
         if not self.clients:
             raise RpcError("no workers connected")
-        if req.halo_depth > 1:
+        # extension fields via getattr: an older client's pickle lacks
+        # them, and absent must mean "default", not AttributeError
+        if getattr(req, "halo_depth", 0) > 1:
             # wide halos are a mesh-plane knob; the reference-shaped
             # scatter/gather has no equivalent — refuse rather than
             # silently running at depth 1
@@ -199,7 +210,7 @@ class WorkersBackend:
                 "the workers backend has no halo_depth knob; use "
                 "-backend tpu for wide halos"
             )
-        if req.rulestring:
+        if getattr(req, "rulestring", ""):
             # the reference-shaped workers hard-code Conway
             # (worker/worker.go:41-46, mirrored in rpc/worker._strip_step);
             # silently evolving a resumed non-Conway checkpoint would
@@ -217,16 +228,17 @@ class WorkersBackend:
                 )
         world = np.array(req.world, np.uint8, copy=True)
         h = world.shape[0]
+        initial_turn = getattr(req, "initial_turn", 0)
         with self._lock:
             if self._running:
                 raise RpcError("a run is already in progress")
-            self._world, self._turn = world, req.initial_turn
+            self._world, self._turn = world, initial_turn
             self._paused = False
             self._parked = False
             self._running = True
 
         try:
-            self._turn_loop(req, h)
+            self._turn_loop(req, h, initial_turn)
             # capture the result BEFORE clearing _running: once the flag
             # drops, a reattaching Run may overwrite _world/_turn
             with self._lock:
@@ -251,7 +263,7 @@ class WorkersBackend:
             y += size
         return bounds
 
-    def _turn_loop(self, req: Request, h: int) -> None:
+    def _turn_loop(self, req: Request, h: int, initial_turn: int = 0) -> None:
         """Per-turn scatter/gather with elastic recovery: a worker that dies
         mid-run is dropped and its rows re-split over the survivors — the
         fault-tolerance extension the reference leaves unimplemented
@@ -281,7 +293,7 @@ class WorkersBackend:
         n, bounds = plan()
         # one pool per run, not n fresh threads per turn
         with concurrent.futures.ThreadPoolExecutor(len(active)) as pool:
-            for _ in range(req.turns - req.initial_turn):
+            for _ in range(req.turns - initial_turn):
                 with self._lock:
                     while self._paused and not self._quit:
                         self._parked = True
@@ -373,6 +385,16 @@ class WorkersBackend:
         )
 
 
+def _require_request(req) -> Request:
+    """Version-skew tolerance is for REQUEST OBJECTS missing newer fields
+    (read via getattr below), never for arbitrary deserialised frames: a
+    missing/None/list request must stay an error reply (the malformed-
+    envelope contract, tests/test_rpc.py), not be defaulted into a call."""
+    if not isinstance(req, Request):
+        raise TypeError(f"request must be a Request, got {type(req).__name__}")
+    return req
+
+
 class BrokerService:
     """Maps the wire verbs onto a backend; owns process shutdown."""
 
@@ -382,11 +404,15 @@ class BrokerService:
         self.quit_event = threading.Event()
 
     def run(self, req: Request) -> Response:
+        req = _require_request(req)
         # server-side resume validation: the client's checkpoint loader
-        # validates too, but this surface is reachable by any client
-        if not 0 <= req.initial_turn <= req.turns:
+        # validates too, but this surface is reachable by any client.
+        # getattr: initial_turn is an extension field — absent on a
+        # version-skewed older client's pickle, meaning 0 (fresh run)
+        initial_turn = getattr(req, "initial_turn", 0)
+        if not 0 <= initial_turn <= req.turns:
             raise ValueError(
-                f"initial_turn {req.initial_turn} outside [0, {req.turns}]"
+                f"initial_turn {initial_turn} outside [0, {req.turns}]"
             )
         if req.world is not None and req.world.shape != (
             req.image_height,
@@ -435,8 +461,24 @@ class BrokerService:
         self._server.wait_idle(timeout=60)
         self._shutdown()
 
+    def status(self, req: Request) -> Response:
+        """Read-only registry snapshot (obs/): answerable mid-Run without
+        touching the engine or the board. Deliberately ignores every
+        request field — version-skew-safe by construction."""
+        from ..obs.report import status_payload
+
+        return Response(
+            status=status_payload(
+                role="broker", backend=type(self.backend).__name__
+            )
+        )
+
     def retrieve(self, req: Request) -> Response:
-        snap = self.backend.retrieve(req.include_world)
+        # include_world is an extension field too: absent means the
+        # original full-world Retrieve
+        snap = self.backend.retrieve(
+            getattr(_require_request(req), "include_world", True)
+        )
         # alive stays empty on the wire: the client derives cells from the
         # world locally, and pickling ~10^5 Cell objects per snapshot is
         # pure waste (the reference DOES ship them, broker/broker.go:272)
@@ -472,6 +514,7 @@ def serve(
     server.register(Methods.QUIT, service.quit)
     server.register(Methods.SUPER_QUIT, service.super_quit)
     server.register(Methods.RETRIEVE, service.retrieve)
+    server.register(Methods.STATUS, service.status)
     server.serve_background()
     return server, service
 
@@ -502,7 +545,16 @@ def main(argv=None) -> None:
         help="tpu backend: turns per halo exchange on the mesh planes "
              "(wide halos — raise on DCN-crossed meshes)",
     )
+    parser.add_argument(
+        "-metrics", action="store_true", default=False,
+        help="enable the metrics registry (obs/): per-verb RPC and engine "
+             "timings, served live by the read-only Operations.Status verb",
+    )
     args = parser.parse_args(argv)
+    if args.metrics:
+        from ..obs import metrics
+
+        metrics.enable()
     if args.halo_depth < 1:
         parser.error(f"-halo-depth must be >= 1, got {args.halo_depth}")
     if args.halo_depth > 1 and args.backend != "tpu":

@@ -12,8 +12,11 @@ from __future__ import annotations
 import itertools
 import socket
 import threading
+import time
 
-from .protocol import Methods, Request, recv_frame, send_frame
+from ..obs import instruments as _ins
+from ..obs import metrics as _metrics
+from .protocol import Methods, Request, recv_frame_sized, send_frame
 
 
 class RpcError(Exception):
@@ -43,11 +46,12 @@ class RpcClient:
         # call, not silently kill this thread and hang them forever
         try:
             while True:
-                msg = recv_frame(self._sock)
+                msg, nbytes = recv_frame_sized(self._sock)
                 with self._pending_lock:
                     slot = self._pending.pop(msg["id"], None)
                 if slot is not None:
                     slot["reply"] = msg
+                    slot["reply_bytes"] = nbytes
                     slot["event"].set()
         except Exception:
             self._closed.set()
@@ -56,8 +60,28 @@ class RpcClient:
                     slot["event"].set()
                 self._pending.clear()
 
-    def call(self, method: str, request: Request):
-        """Blocking call, safe from any thread."""
+    def call(self, method: str, request: Request, timeout: float | None = None):
+        """Blocking call, safe from any thread. ``timeout`` bounds the wait
+        for the REPLY (None: forever — Run legitimately blocks for the
+        whole game); on expiry the pending slot is dropped and RpcError
+        raised, so a wedged server can't hang a poller (obs/status.py)."""
+        if not _metrics.enabled():
+            return self._call(method, request, timeout)
+        # per-verb observability (obs/instruments.py): count + round-trip
+        # latency on every outcome, errors separately
+        _ins.RPC_CLIENT_REQUESTS_TOTAL.labels(method).inc()
+        t0 = time.monotonic()
+        try:
+            return self._call(method, request, timeout)
+        except RpcError:
+            _ins.RPC_CLIENT_ERRORS_TOTAL.labels(method).inc()
+            raise
+        finally:
+            _ins.RPC_CLIENT_REQUEST_SECONDS.labels(method).observe(
+                time.monotonic() - t0
+            )
+
+    def _call(self, method: str, request: Request, timeout: float | None = None):
         if self._closed.is_set():
             raise RpcError("connection closed")
         call_id = next(self._ids)
@@ -72,7 +96,7 @@ class RpcClient:
             raise RpcError("connection closed")
         try:
             with self._write_lock:
-                send_frame(
+                sent = send_frame(
                     self._sock,
                     {"id": call_id, "method": method, "request": request},
                 )
@@ -80,10 +104,19 @@ class RpcClient:
             with self._pending_lock:
                 self._pending.pop(call_id, None)
             raise RpcError(f"send failed: {e}") from e
-        slot["event"].wait()
+        if _metrics.enabled():
+            _ins.RPC_CLIENT_SENT_BYTES_TOTAL.labels(method).inc(sent)
+        if not slot["event"].wait(timeout):
+            with self._pending_lock:
+                self._pending.pop(call_id, None)
+            raise RpcError(f"no reply to {method} within {timeout}s")
         reply = slot["reply"]
         if reply is None:
             raise RpcError("connection closed before reply")
+        if _metrics.enabled():
+            _ins.RPC_CLIENT_RECEIVED_BYTES_TOTAL.labels(method).inc(
+                slot.get("reply_bytes", 0)
+            )
         if "error" in reply:
             raise RpcError(reply["error"])
         return reply["result"]
@@ -149,6 +182,12 @@ class RemoteBroker:
         from ..engine.engine import Snapshot
 
         return Snapshot(res.world, res.turns_completed, res.alive_count)
+
+    def status(self) -> dict:
+        """Read-only metrics snapshot of the remote broker (the Status
+        verb, obs/). Empty dict from a pre-Status server's Response."""
+        res = self.client.call(Methods.STATUS, Request())
+        return getattr(res, "status", None) or {}
 
     def close(self):
         self.client.close()

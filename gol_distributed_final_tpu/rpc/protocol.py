@@ -32,8 +32,12 @@ class Methods:
     PAUSE = "Operations.Pause"
     QUIT = "Operations.Quit"
     SUPER_QUIT = "Operations.SuperQuit"
+    # extension: read-only metrics snapshot (obs/) — interrogate a running
+    # server without touching the engine or the board
+    STATUS = "Operations.Status"
     WORKER_UPDATE = "GameOfLifeOperations.Update"
     WORKER_QUIT = "GameOfLifeOperations.WorkerQuit"
+    WORKER_STATUS = "GameOfLifeOperations.Status"
 
 
 @dataclasses.dataclass
@@ -71,6 +75,10 @@ class Response:
     world: Optional[np.ndarray] = None
     work_slice: Optional[np.ndarray] = None
     worker: int = 0
+    # extension: the Status verb's payload (obs/report.status_payload) —
+    # plain JSON-able dict so it crosses the restricted unpickler. Readers
+    # use getattr(res, "status", None): an older peer's pickle lacks it.
+    status: Optional[dict] = None
 
 
 # -- deserialisation allowlist ----------------------------------------------
@@ -114,13 +122,15 @@ _HEADER = struct.Struct(">Q")
 MAX_FRAME = 1 << 34  # 16 GiB: a 65536^2 board is ~4 GiB
 
 
-def send_frame(sock, obj) -> None:
+def send_frame(sock, obj) -> int:
     """Callers must serialise sends per-socket (both RpcClient and RpcServer
     hold a write lock). Two sendalls avoid concatenating header+payload,
-    which would double peak memory on multi-GiB board frames."""
+    which would double peak memory on multi-GiB board frames. Returns the
+    frame size in bytes (header + payload) — the senders' byte meters."""
     payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
     sock.sendall(_HEADER.pack(len(payload)))
     sock.sendall(payload)
+    return _HEADER.size + len(payload)
 
 
 def _recv_exact(sock, n: int) -> bytes:
@@ -134,8 +144,13 @@ def _recv_exact(sock, n: int) -> bytes:
     return b"".join(chunks)
 
 
-def recv_frame(sock):
+def recv_frame_sized(sock):
+    """``(obj, frame_bytes)`` — the receivers' byte meters ride along."""
     (length,) = _HEADER.unpack(_recv_exact(sock, _HEADER.size))
     if length > MAX_FRAME:
         raise ConnectionError(f"frame of {length} bytes exceeds limit")
-    return loads_restricted(_recv_exact(sock, length))
+    return loads_restricted(_recv_exact(sock, length)), _HEADER.size + length
+
+
+def recv_frame(sock):
+    return recv_frame_sized(sock)[0]

@@ -10,8 +10,11 @@ from __future__ import annotations
 
 import socket
 import threading
+import time
 
-from .protocol import recv_frame, send_frame
+from ..obs import instruments as _ins
+from ..obs import metrics as _metrics
+from .protocol import recv_frame_sized, send_frame
 
 
 class RpcServer:
@@ -58,7 +61,7 @@ class RpcServer:
         try:
             while True:
                 try:
-                    msg = recv_frame(conn)
+                    msg, nbytes = recv_frame_sized(conn)
                 except Exception:
                     # disconnect (ConnectionError/OSError), forbidden global
                     # (pickle.UnpicklingError), truncated pickle (EOFError),
@@ -67,15 +70,17 @@ class RpcServer:
                     return
                 threading.Thread(
                     target=self._dispatch,
-                    args=(conn, write_lock, msg),
+                    args=(conn, write_lock, msg, nbytes),
                     daemon=True,
                 ).start()
         finally:
             conn.close()
 
-    def _dispatch(self, conn, write_lock, msg) -> None:
+    def _dispatch(self, conn, write_lock, msg, nbytes: int = 0) -> None:
         with self._inflight_cv:
             self._inflight += 1
+        t0 = time.monotonic() if _metrics.enabled() else 0.0
+        verb = None  # the per-method metric label, once recoverable
         try:
             # anything can be missing or of the wrong type in a frame that
             # deserialised through the allowlist (plain lists/dicts are
@@ -90,6 +95,12 @@ class RpcServer:
             method = envelope.get("method")
             request = envelope.get("request")
             fn = self._methods.get(method) if isinstance(method, str) else None
+            # bound label cardinality: only REGISTERED verbs label series;
+            # arbitrary method strings from a hostile peer collapse to one
+            verb = method if fn is not None else "<unknown>"
+            if _metrics.enabled():
+                _ins.RPC_SERVER_REQUESTS_TOTAL.labels(verb).inc()
+                _ins.RPC_SERVER_RECEIVED_BYTES_TOTAL.labels(verb).inc(nbytes)
             if fn is None:
                 reply = {"id": call_id, "error": f"unknown method: {method!r}"}
             else:
@@ -97,12 +108,22 @@ class RpcServer:
                     reply = {"id": call_id, "result": fn(request)}
                 except Exception as e:  # error crosses the wire, like net/rpc
                     reply = {"id": call_id, "error": f"{type(e).__name__}: {e}"}
+            if "error" in reply and _metrics.enabled():
+                _ins.RPC_SERVER_ERRORS_TOTAL.labels(verb).inc()
             try:
                 with write_lock:
-                    send_frame(conn, reply)
+                    sent = send_frame(conn, reply)
+                if _metrics.enabled():
+                    _ins.RPC_SERVER_SENT_BYTES_TOTAL.labels(verb).inc(sent)
             except OSError:
                 pass  # peer went away; nothing to tell it
         finally:
+            # t0 gates too: metrics toggled on mid-call must not observe
+            # a bogus (now - 0.0) latency
+            if verb is not None and t0 and _metrics.enabled():
+                _ins.RPC_SERVER_REQUEST_SECONDS.labels(verb).observe(
+                    time.monotonic() - t0
+                )
             # the reply frame is on the wire: only now does the call stop
             # counting as in-flight (wait_idle gates process shutdown on this)
             with self._inflight_cv:

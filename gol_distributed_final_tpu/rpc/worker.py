@@ -80,6 +80,13 @@ class WorkerService:
         threading.Timer(0.05, self._shutdown).start()
         return Response()
 
+    def status(self, req: Request) -> Response:
+        """Read-only registry snapshot (obs/) — the broker verb's worker
+        twin. Ignores every request field: version-skew-safe."""
+        from ..obs.report import status_payload
+
+        return Response(status=status_payload(role="worker"))
+
     def _shutdown(self):
         self._server.stop()
         self.quit_event.set()
@@ -90,6 +97,7 @@ def serve(port: int = 8030, host: str = "127.0.0.1") -> tuple[RpcServer, WorkerS
     service = WorkerService(server)
     server.register(Methods.WORKER_UPDATE, service.update)
     server.register(Methods.WORKER_QUIT, service.worker_quit)
+    server.register(Methods.WORKER_STATUS, service.status)
     server.serve_background()
     return server, service
 
@@ -101,7 +109,16 @@ def main(argv=None) -> None:
         "-host", default="127.0.0.1",
         help="bind address; 0.0.0.0 opts into external exposure",
     )
+    parser.add_argument(
+        "-metrics", action="store_true", default=False,
+        help="enable the metrics registry (obs/), served live by the "
+             "read-only GameOfLifeOperations.Status verb",
+    )
     args = parser.parse_args(argv)
+    if args.metrics:
+        from ..obs import metrics
+
+        metrics.enable()
     server, service = serve(args.port, args.host)
     print(f"worker listening on :{server.port}", flush=True)
     service.quit_event.wait()

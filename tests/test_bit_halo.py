@@ -421,6 +421,8 @@ class TestWideHalos:
         equals the single-device bitboard under the same rule — the three
         knobs must compose for the whole rule space, not just Conway
         (extends test_bitpack's rule-space property onto the mesh)."""
+        # gate, don't fail: hypothesis is absent from some CI images
+        pytest.importorskip("hypothesis")
         from hypothesis import given, settings
         from hypothesis import strategies as st
 

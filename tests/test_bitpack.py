@@ -204,6 +204,8 @@ def test_any_rule_bitboard_matches_oracle_property():
     The named-rule tests pin 4 points; this sweeps randomly drawn ones
     (hypothesis) — a masked term lost in the adder tree for some
     neighbour count would be caught here."""
+    # gate, don't fail: hypothesis is absent from some CI images
+    pytest.importorskip("hypothesis")
     from hypothesis import given, settings
     from hypothesis import strategies as st
 

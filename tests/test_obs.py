@@ -1,0 +1,372 @@
+"""Observability-layer tests (obs/): registry semantics, the live Status
+verb against real broker/worker subprocesses, the RunReport artifact, the
+version-skew request handling, and the metric-name lint.
+"""
+
+import json
+import queue
+
+import numpy as np
+import pytest
+
+from gol_distributed_final_tpu import Params, run
+from gol_distributed_final_tpu.io.pgm import read_board
+from gol_distributed_final_tpu.obs import metrics as obs_metrics
+from gol_distributed_final_tpu.obs.metrics import (
+    DEFAULT_BUCKETS,
+    Registry,
+    merge_snapshots,
+    parse_prometheus_text,
+    snapshot_to_prometheus,
+)
+from gol_distributed_final_tpu.rpc.client import RemoteBroker, RpcClient
+from gol_distributed_final_tpu.rpc.protocol import Methods, Request
+
+from helpers import REPO_ROOT
+from test_rpc import _spawn, _wait_listening
+
+
+@pytest.fixture
+def live_metrics():
+    """Enable the process-global registry for one test, zeroed before and
+    disabled+zeroed after — other tests must keep seeing the no-op
+    default."""
+    reg = obs_metrics.registry()
+    reg.reset()
+    obs_metrics.enable()
+    yield reg
+    obs_metrics.enable(False)
+    reg.reset()
+
+
+def _series(snapshot: dict, name: str) -> dict:
+    """{labels_tuple: series_dict} for one family of a snapshot."""
+    for fam in snapshot["families"]:
+        if fam["name"] == name:
+            return {tuple(s["labels"]): s for s in fam["series"]}
+    return {}
+
+
+# -- registry unit tests -----------------------------------------------------
+
+
+def test_histogram_bucket_math():
+    """Observations land in the first bucket whose edge >= value (the
+    Prometheus ``le`` contract), values past the last edge overflow, and
+    sum/count track exactly."""
+    r = Registry()
+    h = r.histogram("h", buckets=(0.1, 1.0, 10.0))
+    h.observe(0.05)   # <= 0.1        -> bucket 0
+    h.observe(0.1)    # == edge, le   -> bucket 0
+    h.observe(0.5)    # <= 1.0        -> bucket 1
+    h.observe(10.0)   # == last edge  -> bucket 2
+    h.observe(99.0)   # past the end  -> overflow
+    (series,) = _series(r.snapshot(), "h").values()
+    assert series["buckets"] == [2, 1, 1, 1]
+    assert series["count"] == 5
+    assert series["sum"] == pytest.approx(0.05 + 0.1 + 0.5 + 10.0 + 99.0)
+
+
+def test_histogram_observe_n_counts_as_n():
+    """The engine's chunked form: one call records a whole chunk's turns,
+    so histogram count == turn count."""
+    r = Registry()
+    h = r.histogram("h")
+    h.observe_n(0.001, 64)
+    (series,) = _series(r.snapshot(), "h").values()
+    assert series["count"] == 64
+    assert series["sum"] == pytest.approx(0.064)
+
+
+def test_merge_is_exact_bucketwise_addition():
+    """Fixed edges make the cross-host merge exact: merging two snapshots
+    equals one registry that saw both observation streams."""
+    def fill(reg, values):
+        h = reg.histogram("h")
+        c = reg.counter("c", labelnames=("k",))
+        for v in values:
+            h.observe(v)
+            c.labels("x").inc()
+
+    a, b, union = Registry(), Registry(), Registry()
+    fill(a, [0.001, 0.5])
+    fill(b, [0.5, 7.0, 1e6])
+    fill(union, [0.001, 0.5, 0.5, 7.0, 1e6])
+    merged = merge_snapshots(a.snapshot(), b.snapshot())
+    assert merged == union.snapshot()
+    # gauges merge by max (a meaningful high-water semantics)
+    a2, b2 = Registry(), Registry()
+    a2.gauge("g").set(3)
+    b2.gauge("g").set(5)
+    (g,) = _series(merge_snapshots(a2.snapshot(), b2.snapshot()), "g").values()
+    assert g["value"] == 5
+
+
+def test_merge_refuses_mismatched_edges():
+    a, b = Registry(), Registry()
+    a.histogram("h", buckets=(1.0, 2.0))
+    b.histogram("h", buckets=(1.0, 3.0))
+    with pytest.raises(ValueError, match="bucket-edge"):
+        merge_snapshots(a.snapshot(), b.snapshot())
+
+
+def test_prometheus_exposition_round_trip():
+    """Every sample the text exposition emits parses back to exactly the
+    registry's state — cumulative buckets, +Inf, label escaping."""
+    r = Registry()
+    h = r.histogram("rt_seconds", "help text", ("method",))
+    h.labels("Operations.Run").observe(0.3)
+    h.labels("Operations.Run").observe_n(0.02, 5)
+    r.counter("rt_total", labelnames=("m",)).labels("a b").inc(7)
+    r.gauge("rt_gauge").set(2.5)
+    parsed = parse_prometheus_text(snapshot_to_prometheus(r.snapshot()))
+    assert parsed['rt_seconds_count{method="Operations.Run"}'] == 6
+    assert parsed['rt_seconds_sum{method="Operations.Run"}'] == pytest.approx(0.4)
+    assert parsed['rt_seconds_bucket{method="Operations.Run",le="+Inf"}'] == 6
+    # cumulative at an intermediate edge: the 5 fast observations
+    assert parsed['rt_seconds_bucket{method="Operations.Run",le="0.025"}'] == 5
+    assert parsed['rt_total{m="a b"}'] == 7
+    assert parsed['rt_gauge'] == 2.5
+    # sample count: one line per bucket edge + inf + sum + count + 2 scalars
+    assert len(parsed) == len(DEFAULT_BUCKETS) + 1 + 2 + 2
+
+
+def test_disabled_registry_records_nothing():
+    r = Registry(enabled=False)
+    c, h = r.counter("c"), r.histogram("h")
+    c.inc(10)
+    h.observe(1.0)
+    snap = r.snapshot()
+    (cs,) = _series(snap, "c").values()
+    (hs,) = _series(snap, "h").values()
+    assert cs["value"] == 0 and hs["count"] == 0
+
+
+def test_reregistration_is_idempotent_but_signature_checked():
+    r = Registry()
+    c1 = r.counter("c", "help", ("k",))
+    assert r.counter("c", "help", ("k",)) is c1
+    with pytest.raises(ValueError, match="different signature"):
+        r.histogram("c")
+
+
+# -- the version-skew fix (ADVICE r5) ----------------------------------------
+
+
+def _strip_extensions(req: Request) -> Request:
+    """Simulate an older client: its pickled Request simply lacks the
+    extension fields, so the server-side attribute is MISSING, not 0."""
+    for field in ("halo_depth", "rulestring", "initial_turn", "include_world"):
+        del req.__dict__[field]
+    return req
+
+
+def test_old_client_request_gets_default_behavior():
+    """A version-skewed client whose Request pickle predates the extension
+    fields must get the server's default behavior (depth from -halo-depth,
+    fresh run, full-world retrieve) — not an opaque AttributeError reply."""
+    from gol_distributed_final_tpu.rpc.broker import serve
+
+    server, service = serve(port=0)
+    client = RpcClient(f"127.0.0.1:{server.port}")
+    try:
+        p = Params(turns=4, threads=8, image_width=16, image_height=16)
+        board = read_board(p, REPO_ROOT / "images")
+        req = _strip_extensions(
+            Request(
+                world=board, turns=4, image_width=16, image_height=16, threads=8
+            )
+        )
+        res = client.call(Methods.BROKER_RUN, req)
+        assert res.turns_completed == 4
+        assert res.world.shape == (16, 16)
+        # retrieve without include_world = the original full-world form
+        snap = client.call(Methods.RETRIEVE, _strip_extensions(Request()))
+        assert snap.world is not None and snap.turns_completed == 4
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_old_client_request_on_workers_backend_paths():
+    """The WorkersBackend reads the same extension fields defensively: an
+    extension-less Request must clear every admission check (halo_depth,
+    rulestring) and the initial-turn read without AttributeError. turns=0
+    keeps the scatter loop empty, so the stub client is never called."""
+    from gol_distributed_final_tpu.rpc.broker import WorkersBackend
+
+    backend = WorkersBackend([])
+    backend.clients = [object()]  # passes the connected check, never used
+    req = _strip_extensions(
+        Request(
+            world=np.zeros((8, 8), np.uint8),
+            turns=0,
+            image_width=8,
+            image_height=8,
+        )
+    )
+    res = backend.run(req)
+    assert res.turns_completed == 0
+    assert res.world.shape == (8, 8)
+
+
+# -- Status verb + RunReport integration -------------------------------------
+
+
+def test_status_verb_live_tpu_broker():
+    """A -metrics tpu-backend broker answers Operations.Status mid-life
+    with plausible per-verb and engine counters: the acceptance shape —
+    step histogram count == turns evolved, Run verb counted server-side."""
+    broker = _spawn(
+        "gol_distributed_final_tpu.rpc.broker", "-port", "0", "-metrics"
+    )
+    try:
+        port = _wait_listening(broker)
+        remote = RemoteBroker(f"127.0.0.1:{port}")
+        try:
+            p = Params(turns=20, threads=8, image_width=64, image_height=64)
+            board = read_board(p, REPO_ROOT / "images")
+            result = remote.run(p, board)
+            assert result.turns_completed == 20
+            status = remote.status()
+        finally:
+            remote.close()
+        assert status["metrics_enabled"] is True
+        assert status["role"] == "broker"
+        snap = status["metrics"]
+        run_series = _series(snap, "gol_rpc_server_requests_total")
+        assert run_series[("Operations.Run",)]["value"] >= 1
+        assert run_series[("Operations.Status",)]["value"] >= 1
+        (step,) = _series(snap, "gol_engine_step_seconds").values()
+        assert step["count"] == 20
+        (turns,) = _series(snap, "gol_engine_turns_total").values()
+        assert turns["value"] == 20
+        # Status is read-only: a second snapshot still serves, run intact
+        client = RpcClient(f"127.0.0.1:{port}")
+        try:
+            again = client.call(Methods.STATUS, Request())
+            assert again.status["metrics"]["families"]
+        finally:
+            client.close()
+    finally:
+        if broker.poll() is None:
+            broker.kill()
+        broker.wait()
+
+
+def test_status_verb_counts_update_calls_across_workers_backend():
+    """The workers-backend broker's OUTBOUND Update traffic shows in its
+    Status reply (client-side per-verb counters), and a -metrics worker's
+    own Status shows the INBOUND side — both ends of the wire metered."""
+    workers = [
+        _spawn("gol_distributed_final_tpu.rpc.worker", "-port", "0", "-metrics")
+        for _ in range(2)
+    ]
+    broker = None
+    try:
+        ports = [_wait_listening(w) for w in workers]
+        addrs = ",".join(f"127.0.0.1:{p}" for p in ports)
+        broker = _spawn(
+            "gol_distributed_final_tpu.rpc.broker",
+            "-port", "0", "-backend", "workers", "-workers", addrs, "-metrics",
+        )
+        broker_port = _wait_listening(broker)
+        remote = RemoteBroker(f"127.0.0.1:{broker_port}")
+        try:
+            p = Params(turns=10, threads=2, image_width=16, image_height=16)
+            board = read_board(p, REPO_ROOT / "images")
+            assert remote.run(p, board).turns_completed == 10
+            status = remote.status()
+        finally:
+            remote.close()
+        update = ("GameOfLifeOperations.Update",)
+        outbound = _series(status["metrics"], "gol_rpc_client_requests_total")
+        # 10 turns scattered over 2 workers: 20 Update calls
+        assert outbound[update]["value"] == 20
+        sent = _series(status["metrics"], "gol_rpc_client_sent_bytes_total")
+        assert sent[update]["value"] > 0
+        lat = _series(status["metrics"], "gol_rpc_client_request_seconds")
+        assert lat[update]["count"] == 20
+
+        from gol_distributed_final_tpu.obs.status import fetch_status
+
+        wstatus = fetch_status(f"127.0.0.1:{ports[0]}", worker=True)
+        assert wstatus["role"] == "worker"
+        inbound = _series(
+            wstatus["metrics"], "gol_rpc_server_requests_total"
+        )
+        assert inbound[update]["value"] == 10
+    finally:
+        for proc in (*workers, *( [broker] if broker else [] )):
+            if proc.poll() is None:
+                proc.kill()
+            proc.wait()
+
+
+def test_run_report_written_and_parseable(live_metrics, tmp_path):
+    """A short headless run with -report semantics: the RunReport exists,
+    parses, and its per-turn step histogram count equals the turn count
+    (the acceptance criterion, scaled down for CI)."""
+    p = Params(turns=30, threads=8, image_width=64, image_height=64)
+    result = run(
+        p,
+        queue.Queue(),
+        images_dir=REPO_ROOT / "images",
+        out_dir=tmp_path / "out",
+        tick_seconds=3600.0,
+        report=True,
+    )
+    assert result.turns_completed == 30
+    path = tmp_path / "out" / "report_64x64x30.json"
+    assert path.exists()
+    report = json.loads(path.read_text())
+    assert report["schema"] == "gol-run-report/1"
+    assert report["params"]["turns"] == 30
+    assert report["wall_seconds"] > 0
+    assert report["devices"]["local_devices"], "device inventory missing"
+    (step,) = _series(report["metrics"], "gol_engine_step_seconds").values()
+    assert step["count"] == 30
+    assert "gol_engine_step_seconds" in report["stage_timings"]
+    assert report["stage_timings"]["gol_engine_turns_total"] == 30
+    events = _series(report["metrics"], "gol_controller_events_total")
+    assert events[("FinalTurnComplete",)]["value"] == 1
+
+
+def test_report_flag_off_writes_nothing(tmp_path):
+    p = Params(turns=4, threads=8, image_width=16, image_height=16)
+    run(
+        p,
+        queue.Queue(),
+        images_dir=REPO_ROOT / "images",
+        out_dir=tmp_path / "out",
+        tick_seconds=3600.0,
+    )
+    assert not list((tmp_path / "out").glob("report_*.json"))
+
+
+# -- tooling -----------------------------------------------------------------
+
+
+def test_every_registered_metric_is_documented():
+    """The check-style lint: obs/instruments.py and the README table are
+    one contract — an instrument added without docs fails here."""
+    from gol_distributed_final_tpu.obs.lint import undocumented_metrics
+
+    assert undocumented_metrics() == []
+
+
+def test_status_cli_formats(live_metrics, capsys):
+    """The operator one-liner renders both formats against a live server."""
+    from gol_distributed_final_tpu.obs.status import main as status_main
+    from gol_distributed_final_tpu.rpc.broker import serve
+
+    server, service = serve(port=0)
+    try:
+        assert status_main([f"127.0.0.1:{server.port}"]) == 0
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["metrics_enabled"] is True
+        assert status_main(["-format", "prom", f":{server.port}"]) == 0
+        parsed = parse_prometheus_text(capsys.readouterr().out)
+        assert 'gol_rpc_server_requests_total{method="Operations.Status"}' in parsed
+    finally:
+        server.stop()
