@@ -48,6 +48,8 @@ import statistics
 import sys
 import time
 
+from gol_distributed_final_tpu.obs import tracing as _tracing
+
 BASELINE_CELL_UPDATES_PER_SEC = 50 * 512 * 512  # documented estimate, see above
 
 GOLDEN_512 = {1000: 6444, 10000: 5565}  # check/alive/512x512.csv
@@ -145,23 +147,62 @@ def gated(time_fn, n_lo, n_hi, label, attempts=3):
     latency spikes can push a single sampling below the noise margin
     (observed once in three r5 full runs, on the untouched c2 config) —
     a fresh sampling recovers, a REAL noise problem still fails after
-    ``attempts``. Never weakens the gate itself."""
+    ``attempts``. Never weakens the gate itself.
+
+    Each config's sampling runs inside a ``bench.stage`` span, so a
+    ``--trace`` bench leaves a per-stage timeline (out/trace_bench.json)
+    beside the published numbers."""
     last = None
-    for i in range(attempts):
-        try:
-            return marginal(time_fn, n_lo, n_hi, label)
-        except InvalidMeasurement as exc:
-            last = exc
-            if i + 1 < attempts:
-                print(
-                    f"{label}: resampling after noise gate "
-                    f"({i + 1}/{attempts})",
-                    file=sys.stderr,
-                )
+    with _tracing.span(_tracing.SPAN_BENCH_STAGE, stage=label):
+        for i in range(attempts):
+            try:
+                return marginal(time_fn, n_lo, n_hi, label)
+            except InvalidMeasurement as exc:
+                last = exc
+                if i + 1 < attempts:
+                    print(
+                        f"{label}: resampling after noise gate "
+                        f"({i + 1}/{attempts})",
+                        file=sys.stderr,
+                    )
     raise last
 
 
-def main() -> int:
+def main(argv=None) -> int:
+    import argparse
+    import contextlib
+
+    parser = argparse.ArgumentParser(description="GoL TPU benchmark")
+    parser.add_argument(
+        "--trace", action="store_true", default=False,
+        help="record bench.stage / halo.dispatch spans and write a "
+             "Perfetto-loadable out/trace_bench.json beside the JSON line",
+    )
+    parser.add_argument(
+        "--trace-device", dest="trace_device", nargs="?",
+        const="out/trace_device", default=None, metavar="DIR",
+        help="wrap the whole bench in a jax.profiler device trace written "
+             "to DIR (default out/trace_device), span names annotated",
+    )
+    args = parser.parse_args(argv)
+    if args.trace:
+        _tracing.enable()
+        _tracing.set_process_name("bench")
+    device_ctx = (
+        _tracing.device_trace(args.trace_device)
+        if args.trace_device else contextlib.nullcontext()
+    )
+    with device_ctx:
+        rc = _bench_body()
+    if args.trace:
+        path = _tracing.write_chrome_trace(
+            "out/trace_bench.json", _tracing.tracer().snapshot()
+        )
+        print(f"chrome trace written to {path}", file=sys.stderr)
+    return rc
+
+
+def _bench_body() -> int:
     import numpy as np
 
     import jax

@@ -92,9 +92,20 @@ def main(argv=None) -> int:
              "broker with -server (the workers backend computes Conway only)",
     )
     parser.add_argument(
-        "-trace", default=None, metavar="DIR",
-        help="wrap the session in a jax.profiler trace written to DIR "
-             "(the reference's TestTrace role, trace_test.go:12-29)",
+        "-trace", action="store_true", default=False,
+        help="enable the span tracer + flight recorder (obs/tracing.py): "
+             "the session becomes one cross-process trace (controller, "
+             "broker, workers share a trace_id via Request.trace_ctx) and "
+             "a Perfetto-loadable Chrome trace lands in "
+             "out/trace_<W>x<H>x<Turns>.json at session end",
+    )
+    parser.add_argument(
+        "-trace-device", dest="trace_device", nargs="?", const="out/trace_device",
+        default=None, metavar="DIR",
+        help="wrap the session in a jax.profiler DEVICE trace written to "
+             "DIR (default out/trace_device — the reference's TestTrace "
+             "role, trace_test.go:12-29); span names ride along as "
+             "TraceAnnotations so host and device timelines line up",
     )
     parser.add_argument(
         "-halo-depth", dest="halo_depth", type=int, default=0,
@@ -118,6 +129,14 @@ def main(argv=None) -> int:
         from .obs import metrics
 
         metrics.enable()
+    if args.trace:
+        # likewise before any span site runs; the controller role labels
+        # this process's track in the exported Chrome trace
+        from .obs import flight, tracing
+
+        tracing.enable()
+        tracing.set_process_name("controller")
+        flight.enable()
     if args.halo_depth < 0:
         parser.error(
             f"-halo-depth must be >= 1 (or 0 for the broker's default), "
@@ -177,10 +196,11 @@ def main(argv=None) -> int:
         import contextlib
 
         trace_ctx = contextlib.nullcontext()
-        if args.trace:
-            from .utils.trace import trace
+        if args.trace_device:
+            # the profiler trace + host-span alignment (TraceAnnotations)
+            from .obs.tracing import device_trace
 
-            trace_ctx = trace(args.trace)
+            trace_ctx = device_trace(args.trace_device)
         with trace_ctx:
             run(params, events, keypresses, broker=broker, rule=rule,
                 emit_flips=emit_flips, resume_from=args.resume,

@@ -35,6 +35,7 @@ from ..io.pgm import read_board, write_board
 from ..models import CONWAY
 from ..obs import instruments as _ins
 from ..obs import metrics as _metrics
+from ..obs import tracing as _tracing
 from .engine import Engine, EngineConfig, RunResult
 
 CLOSED = object()
@@ -140,13 +141,20 @@ class _Ticker:
 
     _POLL = 0.02
 
-    def __init__(self, params, events, keypresses, broker, out_dir, tick_seconds):
+    def __init__(
+        self, params, events, keypresses, broker, out_dir, tick_seconds,
+        trace_parent=None,
+    ):
         self.params = params
         self.events = events
         self.keypresses = keypresses
         self.broker = broker
         self.out_dir = out_dir
         self.tick_seconds = tick_seconds
+        # the session span's context: tick/key spans run on THIS thread,
+        # where the session's thread-local stack is invisible, so the
+        # parent must be explicit for the whole session to be one trace
+        self._trace_parent = trace_parent
         self.done = threading.Event()
         self.paused = False
         self._last_turn = 0  # last turn seen by any successful retrieve
@@ -197,6 +205,11 @@ class _Ticker:
                 # gated like every other site: metrics off = no clock
                 # reads, no label-child allocation
                 t_key = time.monotonic() if _metrics.enabled() else 0.0
+                key_span = _tracing.start_span(
+                    _tracing.SPAN_CONTROLLER_KEY,
+                    parent_ctx=self._trace_parent,
+                    key=key,
+                )
                 try:
                     self._handle_key(key)
                 except Exception as exc:
@@ -205,6 +218,7 @@ class _Ticker:
                     # dying here silently kills the 2 s tick AND q/k/p
                     print(f"key '{key}' failed: {exc}")
                 finally:
+                    _tracing.end_span(key_span)
                     if t_key:
                         _ins.CONTROLLER_KEY_SECONDS.labels(key).observe(
                             time.monotonic() - t_key
@@ -218,6 +232,10 @@ class _Ticker:
                 # count-only snapshot: a device-side reduction, no full-board
                 # device->host copy on the tick path
                 t_tick = time.monotonic() if _metrics.enabled() else 0.0
+                tick_span = _tracing.start_span(
+                    _tracing.SPAN_CONTROLLER_TICK,
+                    parent_ctx=self._trace_parent,
+                )
                 try:
                     snap = self.broker.retrieve(include_world=False)
                 except Exception as exc:
@@ -225,6 +243,8 @@ class _Ticker:
                     # keypresses (including 'q') still need servicing
                     print(f"tick retrieve failed: {exc}")
                     continue
+                finally:
+                    _tracing.end_span(tick_span)
                 if t_tick:
                     _ins.CONTROLLER_TICK_SECONDS.observe(
                         time.monotonic() - t_tick
@@ -349,9 +369,20 @@ def run(
 
     ticker = None
     t_session = time.monotonic()
+    # the session root span (obs/tracing.py, one flag check when -trace is
+    # off): every tick, keypress, RPC, and remote engine chunk of this
+    # session parents under it — one trace_id across all processes
+    session_span = _tracing.start_span(
+        _tracing.SPAN_CONTROLLER_SESSION,
+        turns=params.turns,
+        board=f"{params.image_width}x{params.image_height}",
+    )
     try:
         world = ckpt_world if resume_from is not None else read_board(params, images_dir)
-        ticker = _Ticker(params, events, keypresses, broker, out_dir, tick_seconds)
+        ticker = _Ticker(
+            params, events, keypresses, broker, out_dir, tick_seconds,
+            trace_parent=session_span.ctx() if session_span else None,
+        )
         ticker.start()
         # a non-default rule rides along to the broker — from a resumed
         # checkpoint or an explicit session rule — so a remote backend
@@ -396,6 +427,27 @@ def run(
                 "a final_world=False engine belongs to the bigboard surface"
             )
         _emit(events, FinalTurnComplete(result.turns_completed, result.alive))
+        if _tracing.enabled():
+            # close the session span FIRST so it lands in the export, then
+            # write the Chrome trace: local spans + whatever the broker's
+            # Status verb ships back (its own spans, and — through a
+            # workers-backend broker's aggregation — each worker's). One
+            # file, one named track per process, Perfetto-loadable. A
+            # failed export must not fail the session it describes.
+            _tracing.end_span(session_span)
+            session_span = None
+            try:
+                spans = _tracing.tracer().snapshot()
+                status_fn = getattr(broker, "status", None)
+                if callable(status_fn):
+                    payload = status_fn()
+                    spans.extend(payload.get("trace_spans") or [])
+                path = _tracing.write_chrome_trace(
+                    _tracing.trace_path(params, out_dir), spans
+                )
+                print(f"chrome trace written to {path}")
+            except Exception as exc:
+                print(f"trace export failed: {exc}")
         if report:
             # the run's attribution artifact, dumped at FinalTurnComplete;
             # a failed dump must not fail the session it describes
@@ -419,6 +471,9 @@ def run(
         _emit(events, StateChange(result.turns_completed, Quitting))
         return result
     finally:
+        # None when already closed for the export above; ends the error
+        # paths' span so the thread-local stack cannot wedge across runs
+        _tracing.end_span(session_span)
         if ticker is not None:
             ticker.done.set()
         # the stream must always terminate, even on error — a consumer

@@ -38,8 +38,10 @@ _PIPELINE_DEPTH = 3
 
 from ..events import CellFlipped, TurnComplete
 from ..models import CONWAY, LifeRule
+from ..obs import flight as _flight
 from ..obs import instruments as _ins
 from ..obs import metrics as _metrics
+from ..obs import tracing as _tracing
 from ..ops import alive_cells
 from ..utils.cell import Cell
 
@@ -334,12 +336,18 @@ class Engine:
                 with self._lock:
                     if self._paused and not self._quit:
                         # the park gate, timed: how long control traffic
-                        # held the data plane still (obs/instruments.py)
+                        # held the data plane still (obs/instruments.py);
+                        # the span makes the stall VISIBLE on the timeline
+                        # (a wedged-looking run that is merely paused)
                         t_park = time.monotonic()
+                        park_span = _tracing.start_span(
+                            _tracing.SPAN_ENGINE_PARK, turn=self._turn
+                        )
                         while self._paused and not self._quit:
                             self._parked = True
                             self._control.notify_all()
                             self._control.wait()
+                        _tracing.end_span(park_span)
                         _ins.ENGINE_PARK_SECONDS.observe(
                             time.monotonic() - t_park
                         )
@@ -354,7 +362,17 @@ class Engine:
 
                 growing = not emit_flips and not growth_done
                 t0 = time.monotonic()
-                new_state = active_plane.step_n(state, n)
+                # per-chunk span (one flag check when -trace is off; the
+                # ring bounds a million-turn run to the recent window).
+                # The matching TraceAnnotation puts the same name on the
+                # device timeline when -trace-device is active, so host
+                # spans and profiler tracks line up.
+                chunk_span = (
+                    _tracing.start_span(_tracing.SPAN_ENGINE_CHUNK, turns=n)
+                    if _tracing.enabled() else None
+                )
+                with _tracing.annotate("engine.chunk"):
+                    new_state = active_plane.step_n(state, n)
                 if growing:
                     # accurate per-chunk timing drives the doubling below
                     new_state.block_until_ready()
@@ -362,6 +380,8 @@ class Engine:
                     inflight.append(new_state)
                     if len(inflight) > _PIPELINE_DEPTH:
                         inflight.popleft().block_until_ready()
+                if chunk_span is not None:
+                    _tracing.end_span(chunk_span, sync=growing)
                 elapsed = time.monotonic() - t0
                 if _metrics.enabled():
                     # per-turn attribution (obs/): dispatch wall spread over
@@ -434,6 +454,10 @@ class Engine:
                 every = self.config.checkpoint_every
                 if every and turn_now // every > (turn_now - n) // every:
                     t_ckpt = time.monotonic()
+                    ckpt_span = _tracing.start_span(
+                        _tracing.SPAN_ENGINE_CHECKPOINT, turn=turn_now
+                    )
+                    attempt_ok = True
                     try:
                         self._write_checkpoint(new_state, turn_now)
                     except Exception as exc:
@@ -449,10 +473,12 @@ class Engine:
                         # catch makes every rank take the SAME continue
                         # decision (ADVICE r5). Surfaced on the RunResult.
                         ckpt_error = exc
+                        attempt_ok = False
                         _ins.ENGINE_CHECKPOINT_ERRORS_TOTAL.inc()
                         print(
                             f"checkpoint at turn {turn_now} failed: {exc}"
                         )
+                    _tracing.end_span(ckpt_span, ok=attempt_ok)
                     _ins.ENGINE_CHECKPOINT_SECONDS.observe(
                         time.monotonic() - t_ckpt
                     )
@@ -475,6 +501,14 @@ class Engine:
                 plane=plane_f,
                 checkpoint_error=ckpt_error,
             )
+        except BaseException as exc:
+            # an UNHANDLED engine exception is exactly the moment the
+            # flight recorder exists for: dump the last-events ring to
+            # out/flight_<host>.jsonl (obs/flight.py — no-op unless -trace
+            # opted in, never raises) before propagating, so a crashed or
+            # desynced rank leaves its post-mortem on disk
+            _flight.dump_on_crash(exc)
+            raise
         finally:
             with self._lock:
                 self._running = False
@@ -525,8 +559,19 @@ class Engine:
                 ok, err = 0, exc
             from jax.experimental import multihost_utils
 
+            # the vote this rank is about to cast, recorded BEFORE the
+            # collective: if a peer never shows up and the allgather
+            # wedges, every surviving rank's flight ring names this exact
+            # crossing as its last act (the rank-desync post-mortem)
+            _flight.record(
+                "ckpt.vote", "checkpoint_agreement", turn=turn, ok=bool(ok)
+            )
             oks = multihost_utils.process_allgather(np.int64(ok))
             failed = int(len(oks) - oks.sum())
+            _flight.record(
+                "ckpt.agree", "checkpoint_agreement", turn=turn,
+                failed_ranks=failed,
+            )
             if failed:
                 raise err if err is not None else OSError(
                     f"checkpoint at turn {turn}: shard write failed on "

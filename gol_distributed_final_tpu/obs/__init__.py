@@ -7,7 +7,7 @@ our port adds the ``jax.profiler`` shim in ``utils/trace.py``. Neither says
 transfer vs. RPC — which is the first question every perf round asks
 (BENCH_r*.json measures only end-to-end time).
 
-This package is the answer, in three parts:
+This package is the answer, in five parts:
 
 * ``metrics``     — a dependency-free registry (counters, gauges,
                     fixed-bucket histograms) with JSON and Prometheus-text
@@ -18,13 +18,24 @@ This package is the answer, in three parts:
                     documents and ``lint`` enforces;
 * ``report``      — the ``RunReport`` writer (registry + device inventory
                     + memory stats -> ``out/report_<W>x<H>x<Turns>.json``)
-                    and the ``Status`` RPC payload builder.
+                    and the ``Status`` RPC payload builder;
+* ``tracing``     — the cross-process span tracer (trace_id propagated
+                    over ``Request.trace_ctx``) with Chrome trace-event
+                    export (``out/trace_<W>x<H>x<Turns>.json``, Perfetto-
+                    loadable) and the ``jax.profiler`` device-trace
+                    fold-in (``-trace-device`` routes ``utils/trace.py``'s
+                    profiler shim into the same out dir, span names pushed
+                    as ``TraceAnnotation``s);
+* ``flight``      — the hang flight-recorder: a bounded per-process ring
+                    of the last structured events (span open/close, RPC
+                    send/recv, checkpoint votes), shipped in ``Status``
+                    replies and dumped to ``out/flight_<host>.jsonl`` on
+                    unhandled engine exceptions.
 
-Everything is process-local and OFF by default: with metrics disabled each
-instrument call is a flag check, so the hot paths cost nothing until an
-operator passes ``-metrics``/``-report`` (or calls ``metrics.enable()``).
-The complementary device-side view — per-dispatch timelines, compiles,
-transfers — stays with ``utils/trace.py``'s ``jax.profiler`` trace.
+Everything is process-local and OFF by default: with metrics and tracing
+disabled each instrument call is a flag check, so the hot paths cost
+nothing until an operator passes ``-metrics``/``-report``/``-trace`` (or
+calls ``metrics.enable()`` / ``tracing.enable()``).
 """
 
 from . import metrics  # noqa: F401

@@ -1,12 +1,15 @@
-"""Metric-name lint: the code's registry and the README's table must agree.
+"""Name lints: the code's registries and the README's tables must agree.
 
-The metric names in ``obs/instruments.py`` are a stable operator contract
-(they appear in RunReports, Status payloads, and Prometheus scrapes), and
-the README "Observability" section is their documentation of record. This
-lint fails when a name registered in code is missing from the README — so
-adding an instrument without documenting it breaks the build
-(``tests/test_obs.py`` runs it; ``python -m gol_distributed_final_tpu.obs.lint``
-runs it standalone).
+Two operator-facing name contracts live in this package: metric names
+(``obs/instruments.py`` — RunReports, Status payloads, Prometheus scrapes)
+and span names (``obs/tracing.py`` — Chrome trace exports, flight-recorder
+events). The README "Observability" and "Tracing" sections are their
+documentation of record. These lints fail when a name registered in code
+is missing from the README — so adding an instrument or a span site
+without documenting it breaks the build (``tests/test_obs.py`` and
+``tests/test_tracing.py`` run them;
+``python -m gol_distributed_final_tpu.obs.lint`` and the ``scripts/check``
+wrapper run them standalone, outside pytest).
 """
 
 from __future__ import annotations
@@ -35,7 +38,18 @@ def undocumented_metrics(readme_path=None, histograms_only: bool = False) -> Lis
     return sorted(missing)
 
 
+def undocumented_spans(readme_path=None) -> List[str]:
+    """Span names declared in obs/tracing.py but absent from the README."""
+    from .tracing import registered_span_names
+
+    if readme_path is None:
+        readme_path = REPO_ROOT / "README.md"
+    text = pathlib.Path(readme_path).read_text()
+    return sorted(n for n in registered_span_names() if n not in text)
+
+
 def main(argv=None) -> int:
+    rc = 0
     missing = undocumented_metrics()
     if missing:
         print(
@@ -45,9 +59,22 @@ def main(argv=None) -> int:
         )
         for name in missing:
             print(f"  {name}", file=sys.stderr)
-        return 1
-    print("metric-name lint ok: every registered metric is documented")
-    return 0
+        rc = 1
+    else:
+        print("metric-name lint ok: every registered metric is documented")
+    missing_spans = undocumented_spans()
+    if missing_spans:
+        print(
+            "span names declared in obs/tracing.py but missing from "
+            "README.md's Tracing table:",
+            file=sys.stderr,
+        )
+        for name in missing_spans:
+            print(f"  {name}", file=sys.stderr)
+        rc = 1
+    else:
+        print("span-name lint ok: every declared span name is documented")
+    return rc
 
 
 if __name__ == "__main__":

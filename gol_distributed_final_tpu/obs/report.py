@@ -21,7 +21,7 @@ import pathlib
 import time
 from typing import Optional
 
-from . import metrics
+from . import flight, metrics, tracing
 
 SCHEMA = "gol-run-report/1"
 
@@ -31,7 +31,12 @@ def status_payload(**extra) -> dict:
 
     Deliberately jax-free: a worker process that never imported jax must
     answer Status without paying that import, and the verb must stay
-    cheap enough to poll."""
+    cheap enough to poll.
+
+    With tracing on, the payload also carries the span ring (the material
+    a controller's Chrome-trace export is built from) and the flight
+    recorder's last-events ring — so a WEDGED process can be post-mortemed
+    live over one read-only RPC."""
     reg = metrics.registry()
     payload = {
         "schema": "gol-status/1",
@@ -40,6 +45,10 @@ def status_payload(**extra) -> dict:
         "metrics_enabled": reg.enabled,
         "metrics": reg.snapshot(),
     }
+    if tracing.enabled():
+        payload["trace_spans"] = tracing.tracer().snapshot()
+    if flight.enabled():
+        payload["flight"] = flight.recorder().snapshot()
     payload.update(extra)
     return payload
 

@@ -42,6 +42,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models import CONWAY, LifeRule
 from ..obs import instruments as _ins
 from ..obs import metrics as _metrics
+from ..obs import tracing as _tracing
 from ..ops.bitpack import WORD, bit_step, pack_device, unpack_device
 from .halo import (
     _exchange,
@@ -324,21 +325,30 @@ def sharded_bit_step_n_fn(
                     f"pallas_local=True requires a sublane/lane-aligned "
                     f"local block; got {tuple(block_shape)}"
                 )
-        if not _metrics.enabled():
+        if not (_metrics.enabled() or _tracing.enabled()
+                or _tracing.device_trace_active()):
             return _compiled(int(n), use_pallas)(packed)
         # host-side dispatch wall + exchange count, labelled by the local
         # route actually taken (obs/); device-side exchange time lives in
-        # the profiler trace
+        # the profiler trace, where the TraceAnnotation carries the same
+        # span name so the two timelines line up
         plane_label = "bit_pallas" if use_pallas else "bit_xla"
-        _ins.COMPILE_CACHE_REQUESTS_TOTAL.labels("halo.bit").inc()
-        _ins.HALO_EXCHANGES_TOTAL.labels(plane_label).inc(
-            exchanges_per_dispatch(int(n), halo_depth)
+        span = _tracing.start_span(
+            _tracing.SPAN_HALO_DISPATCH, plane=plane_label, turns=int(n)
         )
+        if _metrics.enabled():
+            _ins.COMPILE_CACHE_REQUESTS_TOTAL.labels("halo.bit").inc()
+            _ins.HALO_EXCHANGES_TOTAL.labels(plane_label).inc(
+                exchanges_per_dispatch(int(n), halo_depth)
+            )
         t0 = time.monotonic()
-        out = _compiled(int(n), use_pallas)(packed)
-        _ins.HALO_DISPATCH_SECONDS.labels(plane_label).observe(
-            time.monotonic() - t0
-        )
+        with _tracing.annotate("halo.dispatch"):
+            out = _compiled(int(n), use_pallas)(packed)
+        if _metrics.enabled():
+            _ins.HALO_DISPATCH_SECONDS.labels(plane_label).observe(
+                time.monotonic() - t0
+            )
+        _tracing.end_span(span)
         return out
 
     return step_n

@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models import CONWAY, LifeRule
 from ..obs import instruments as _ins
 from ..obs import metrics as _metrics
+from ..obs import tracing as _tracing
 from ..ops.stencil import apply_rule, counts_from_extended
 from .mesh import COLS, ROWS, shard_map_compat
 
@@ -229,20 +230,29 @@ def sharded_step_n_fn(
             halo_depth,
             (board.shape[0] // mesh_shape[0], board.shape[1] // mesh_shape[1]),
         )
-        if not _metrics.enabled():
+        if not (_metrics.enabled() or _tracing.enabled()
+                or _tracing.device_trace_active()):
             return _compiled(int(n))(board)
         # host-side dispatch wall (compile on first call, enqueue after)
         # + the exchange count this dispatch puts on the wire; the
-        # device-side exchange time itself lives in the profiler trace
-        _ins.COMPILE_CACHE_REQUESTS_TOTAL.labels("halo.byte").inc()
-        _ins.HALO_EXCHANGES_TOTAL.labels("byte").inc(
-            exchanges_per_dispatch(int(n), halo_depth)
+        # device-side exchange time itself lives in the profiler trace,
+        # where the TraceAnnotation below carries the same span name
+        span = _tracing.start_span(
+            _tracing.SPAN_HALO_DISPATCH, plane="byte", turns=int(n)
         )
+        if _metrics.enabled():
+            _ins.COMPILE_CACHE_REQUESTS_TOTAL.labels("halo.byte").inc()
+            _ins.HALO_EXCHANGES_TOTAL.labels("byte").inc(
+                exchanges_per_dispatch(int(n), halo_depth)
+            )
         t0 = time.monotonic()
-        out = _compiled(int(n))(board)
-        _ins.HALO_DISPATCH_SECONDS.labels("byte").observe(
-            time.monotonic() - t0
-        )
+        with _tracing.annotate("halo.dispatch"):
+            out = _compiled(int(n))(board)
+        if _metrics.enabled():
+            _ins.HALO_DISPATCH_SECONDS.labels("byte").observe(
+                time.monotonic() - t0
+            )
+        _tracing.end_span(span)
         return out
 
     return step_n

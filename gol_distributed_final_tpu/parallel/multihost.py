@@ -32,6 +32,18 @@ def initialize(
     False for single-process runs so the same code path serves both."""
     if num_processes is None or num_processes <= 1:
         return False
+    # XLA:CPU's default collectives cannot execute multiprocess
+    # computations at all ("Multiprocess computations aren't implemented
+    # on the CPU backend") — the gloo TCP implementation can, and jaxlib
+    # ships it. Selecting it here, before the first backend is created,
+    # makes the CPU test topology (and any real CPU deployment) execute
+    # the same cross-process ppermutes as a TPU pod. Guarded: the option
+    # name is version-dependent and only affects CPU client creation, so
+    # a jax without it simply keeps its default.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

@@ -28,6 +28,7 @@ import threading
 import numpy as np
 
 from ..engine.engine import Engine, RunResult, Snapshot
+from ..obs import tracing as _tracing
 from .client import RpcClient, RpcError
 from .protocol import Methods, Request, Response
 from .server import RpcServer
@@ -270,17 +271,28 @@ class WorkersBackend:
         (README.md:266-270; its gather simply hangs on worker death)."""
         import concurrent.futures
 
-        def scatter(client, world, s, e):
+        def scatter(client, world, s, e, trace_parent=None):
+            # trace_parent: this call runs on a POOL thread where the turn
+            # span's thread-local stack is invisible — the parent must ride
+            # in explicitly for the per-worker Update spans to join the
+            # turn (and through it the caller's whole session trace). Only
+            # passed when tracing set it (like the controller's rule=
+            # kwarg): worker clients are duck-typed and plain fakes need
+            # not know the kwarg.
+            kw = {} if trace_parent is None else {"trace_parent": trace_parent}
             if self._wire == "full":
                 # reference-exact: ship the whole board, worker slices
                 res = client.call(
                     Methods.WORKER_UPDATE,
                     Request(world=world, start_y=s, end_y=e),
+                    **kw,
                 )
             else:
                 rows = np.arange(s - 1, e + 1) % h
                 res = client.call(
-                    Methods.WORKER_UPDATE, Request(world=world[rows], start_y=-1)
+                    Methods.WORKER_UPDATE,
+                    Request(world=world[rows], start_y=-1),
+                    **kw,
                 )
             return res.work_slice
 
@@ -304,37 +316,54 @@ class WorkersBackend:
                         return
                     world = self._world
 
-                while True:  # retries the SAME turn after losing workers
-                    futures = [
-                        pool.submit(scatter, active[i], world, *bounds[i])
-                        for i in range(n)
-                    ]
-                    strips = [None] * n
-                    dead = []
-                    for i, fut in enumerate(futures):
-                        try:
-                            strips[i] = fut.result()
-                        except (RpcError, OSError):
-                            dead.append(i)
-                    if not dead:
-                        break
-                    with self._lock:
-                        if self._quit:
-                            return  # shutdown race, not a failure
-                    for i in sorted(dead, reverse=True):
-                        del active[i]
-                    if not active:
-                        raise RpcError("all workers lost mid-run")
-                    print(
-                        f"{len(dead)} worker(s) lost mid-run; "
-                        f"resplitting over {len(active)}"
+                # one span per turn: the scatter/gather barrier the
+                # reference implements host-side — exactly the region that
+                # wedges when a worker stalls, so it must be on the timeline
+                turn_span = (
+                    _tracing.start_span(
+                        _tracing.SPAN_BROKER_TURN, turn=self._turn, workers=n
                     )
-                    n, bounds = plan()
+                    if _tracing.enabled() else None
+                )
+                tp = turn_span.ctx() if turn_span else None
+                try:
+                    while True:  # retries the SAME turn after losing workers
+                        futures = [
+                            pool.submit(
+                                scatter, active[i], world, *bounds[i], tp
+                            )
+                            for i in range(n)
+                        ]
+                        strips = [None] * n
+                        dead = []
+                        for i, fut in enumerate(futures):
+                            try:
+                                strips[i] = fut.result()
+                            except (RpcError, OSError):
+                                dead.append(i)
+                        if not dead:
+                            break
+                        with self._lock:
+                            if self._quit:
+                                return  # shutdown race, not a failure
+                        for i in sorted(dead, reverse=True):
+                            del active[i]
+                        if not active:
+                            raise RpcError("all workers lost mid-run")
+                        print(
+                            f"{len(dead)} worker(s) lost mid-run; "
+                            f"resplitting over {len(active)}"
+                        )
+                        n, bounds = plan()
 
-                new_world = np.concatenate(strips, axis=0)
-                with self._lock:
-                    self._world = new_world
-                    self._turn += 1
+                    new_world = np.concatenate(strips, axis=0)
+                    with self._lock:
+                        self._world = new_world
+                        self._turn += 1
+                finally:
+                    # ends on every exit — commit, shutdown race, all-lost
+                    # raise — so a wedged NEXT turn is the one left open
+                    _tracing.end_span(turn_span)
 
     def pause(self):
         """Toggle pause. On pause, blocks until the turn loop has actually
@@ -383,6 +412,23 @@ class WorkersBackend:
         return Snapshot(
             world if include_world else None, turn, int(np.count_nonzero(world))
         )
+
+    def collect_remote_spans(self) -> list:
+        """Each connected worker's finished spans, via its own Status verb
+        — so ONE broker Status reply carries the whole fan-out topology and
+        the controller's trace export gets a track per worker. Strictly
+        best-effort with a short reply bound: a dead or wedged worker must
+        cost 2 s, not hang the Status poll (the verb exists to debug
+        exactly such runs); pre-Status workers reply without the field."""
+        spans: list = []
+        for client in self.clients:
+            try:
+                res = client.call(Methods.WORKER_STATUS, Request(), timeout=2.0)
+            except (RpcError, OSError):
+                continue
+            payload = getattr(res, "status", None) or {}
+            spans.extend(payload.get("trace_spans") or [])
+        return spans
 
 
 def _require_request(req) -> Request:
@@ -464,14 +510,24 @@ class BrokerService:
     def status(self, req: Request) -> Response:
         """Read-only registry snapshot (obs/): answerable mid-Run without
         touching the engine or the board. Deliberately ignores every
-        request field — version-skew-safe by construction."""
+        request field — version-skew-safe by construction.
+
+        When tracing is on, the payload also carries this process's span
+        ring + flight ring (obs/report.status_payload), and a workers
+        backend folds in its workers' spans — one poll sees the whole
+        fan-out topology."""
         from ..obs.report import status_payload
 
-        return Response(
-            status=status_payload(
-                role="broker", backend=type(self.backend).__name__
-            )
+        payload = status_payload(
+            role="broker", backend=type(self.backend).__name__
         )
+        collect = getattr(self.backend, "collect_remote_spans", None)
+        if callable(collect) and _tracing.enabled():
+            try:
+                payload.setdefault("trace_spans", []).extend(collect())
+            except Exception as exc:  # a trace must never break Status
+                payload["trace_collect_error"] = str(exc)
+        return Response(status=payload)
 
     def retrieve(self, req: Request) -> Response:
         # include_world is an extension field too: absent means the
@@ -550,11 +606,23 @@ def main(argv=None) -> None:
         help="enable the metrics registry (obs/): per-verb RPC and engine "
              "timings, served live by the read-only Operations.Status verb",
     )
+    parser.add_argument(
+        "-trace", action="store_true", default=False,
+        help="enable the span tracer + flight recorder (obs/tracing.py, "
+             "obs/flight.py): spans join the calling controller's trace "
+             "via Request.trace_ctx and ship back in Status replies",
+    )
     args = parser.parse_args(argv)
     if args.metrics:
         from ..obs import metrics
 
         metrics.enable()
+    if args.trace:
+        from ..obs import flight, tracing
+
+        tracing.enable()
+        tracing.set_process_name("broker")
+        flight.enable()
     if args.halo_depth < 1:
         parser.error(f"-halo-depth must be >= 1, got {args.halo_depth}")
     if args.halo_depth > 1 and args.backend != "tpu":

@@ -14,13 +14,26 @@ import socket
 import threading
 import time
 
+from ..obs import flight as _flight
 from ..obs import instruments as _ins
 from ..obs import metrics as _metrics
+from ..obs import tracing as _tracing
 from .protocol import Methods, Request, recv_frame_sized, send_frame
 
 
 class RpcError(Exception):
-    """A server-side error surfaced to the caller (net/rpc's error return)."""
+    """A server-side error surfaced to the caller (net/rpc's error return).
+
+    ``kind`` is the remote exception CLASS name and ``remote_traceback``
+    a truncated remote traceback — populated from the structured error
+    reply of a current server (both None against an older peer), so a
+    worker-side failure reaching the controller names the exception class
+    and site instead of an opaque string."""
+
+    def __init__(self, message, kind=None, remote_traceback=None):
+        super().__init__(message)
+        self.kind = kind
+        self.remote_traceback = remote_traceback
 
 
 class RpcClient:
@@ -60,26 +73,63 @@ class RpcClient:
                     slot["event"].set()
                 self._pending.clear()
 
-    def call(self, method: str, request: Request, timeout: float | None = None):
+    def call(
+        self,
+        method: str,
+        request: Request,
+        timeout: float | None = None,
+        trace_parent: dict | None = None,
+    ):
         """Blocking call, safe from any thread. ``timeout`` bounds the wait
         for the REPLY (None: forever — Run legitimately blocks for the
         whole game); on expiry the pending slot is dropped and RpcError
-        raised, so a wedged server can't hang a poller (obs/status.py)."""
-        if not _metrics.enabled():
+        raised, so a wedged server can't hang a poller (obs/status.py).
+
+        ``trace_parent`` explicitly parents this call's span for work
+        handed to pool threads (where the caller's thread-local span stack
+        is invisible — the workers-backend scatter); by default the span
+        parents on the calling thread's current span."""
+        if not _metrics.enabled() and not _tracing.enabled():
             return self._call(method, request, timeout)
         # per-verb observability (obs/instruments.py): count + round-trip
-        # latency on every outcome, errors separately
-        _ins.RPC_CLIENT_REQUESTS_TOTAL.labels(method).inc()
+        # latency on every outcome, errors separately; plus a client span
+        # (obs/tracing.py) whose context rides Request.trace_ctx so the
+        # server's dispatch span joins the same trace
+        span = _tracing.start_span(
+            _tracing.SPAN_RPC_CLIENT, parent_ctx=trace_parent, method=method
+        )
+        if span is not None and isinstance(request, Request):
+            request.trace_ctx = span.ctx()
+        _flight.record("rpc.send", method)
+        if _metrics.enabled():
+            _ins.RPC_CLIENT_REQUESTS_TOTAL.labels(method).inc()
         t0 = time.monotonic()
+        err_kind = None
         try:
-            return self._call(method, request, timeout)
-        except RpcError:
-            _ins.RPC_CLIENT_ERRORS_TOTAL.labels(method).inc()
+            result = self._call(method, request, timeout)
+            _flight.record("rpc.recv", method, ok=True)
+            if span is not None:
+                # link to the server-side span when a current server
+                # replied with one (older peers: no field, no link)
+                peer = getattr(result, "trace_ctx", None)
+                if isinstance(peer, dict):
+                    span.args["server_span_id"] = peer.get("span_id")
+            return result
+        except RpcError as e:
+            err_kind = e.kind or type(e).__name__
+            _flight.record("rpc.recv", method, ok=False, error_kind=err_kind)
+            if _metrics.enabled():
+                _ins.RPC_CLIENT_ERRORS_TOTAL.labels(method).inc()
             raise
         finally:
-            _ins.RPC_CLIENT_REQUEST_SECONDS.labels(method).observe(
-                time.monotonic() - t0
-            )
+            if _metrics.enabled():
+                _ins.RPC_CLIENT_REQUEST_SECONDS.labels(method).observe(
+                    time.monotonic() - t0
+                )
+            if err_kind is None:
+                _tracing.end_span(span)
+            else:
+                _tracing.end_span(span, error_kind=err_kind)
 
     def _call(self, method: str, request: Request, timeout: float | None = None):
         if self._closed.is_set():
@@ -118,7 +168,15 @@ class RpcClient:
                 slot.get("reply_bytes", 0)
             )
         if "error" in reply:
-            raise RpcError(reply["error"])
+            # structured error extension: a current server names the remote
+            # exception class + truncated traceback beside the message; an
+            # older server's reply simply lacks the keys (dict.get — the
+            # envelope-level twin of the getattr field posture)
+            raise RpcError(
+                reply["error"],
+                kind=reply.get("error_kind"),
+                remote_traceback=reply.get("error_traceback"),
+            )
         return reply["result"]
 
     def close(self) -> None:
@@ -183,10 +241,14 @@ class RemoteBroker:
 
         return Snapshot(res.world, res.turns_completed, res.alive_count)
 
-    def status(self) -> dict:
+    def status(self, timeout: float = 10.0) -> dict:
         """Read-only metrics snapshot of the remote broker (the Status
-        verb, obs/). Empty dict from a pre-Status server's Response."""
-        res = self.client.call(Methods.STATUS, Request())
+        verb, obs/). Empty dict from a pre-Status server's Response.
+        ``timeout`` bounds the reply wait: the controller's end-of-session
+        trace export calls this, and a broker wedged after the run — the
+        very failure mode tracing exists to debug — must cost seconds,
+        not hang the session exit."""
+        res = self.client.call(Methods.STATUS, Request(), timeout=timeout)
         return getattr(res, "status", None) or {}
 
     def close(self):

@@ -63,6 +63,12 @@ class Request:
     # reachable from the deployment surface, not only the library
     # (VERDICT r4 item 5)
     halo_depth: int = 0
+    # extension: the caller's span context (obs/tracing.py — plain dict of
+    # {trace_id, span_id, sampled}, so it crosses the restricted
+    # unpickler). Servers read it via getattr: a version-skewed peer's
+    # pickle simply lacks it and skew degrades to "no trace", never an
+    # AttributeError. None = the caller isn't tracing.
+    trace_ctx: Optional[dict] = None
 
 
 @dataclasses.dataclass
@@ -79,6 +85,10 @@ class Response:
     # plain JSON-able dict so it crosses the restricted unpickler. Readers
     # use getattr(res, "status", None): an older peer's pickle lacks it.
     status: Optional[dict] = None
+    # extension: the server dispatch span's context (obs/tracing.py), so
+    # the client can link its round-trip span to the handler-side span.
+    # Same skew posture as Request.trace_ctx: getattr, absent = no trace.
+    trace_ctx: Optional[dict] = None
 
 
 # -- deserialisation allowlist ----------------------------------------------

@@ -11,10 +11,17 @@ from __future__ import annotations
 import socket
 import threading
 import time
+import traceback
 
+from ..obs import flight as _flight
 from ..obs import instruments as _ins
 from ..obs import metrics as _metrics
-from .protocol import recv_frame_sized, send_frame
+from ..obs import tracing as _tracing
+from .protocol import Response, recv_frame_sized, send_frame
+
+# structured error replies carry the remote traceback's TAIL (the raise
+# site), truncated so a deep recursion can't balloon an error frame
+_TRACEBACK_LIMIT = 2000
 
 
 class RpcServer:
@@ -101,15 +108,52 @@ class RpcServer:
             if _metrics.enabled():
                 _ins.RPC_SERVER_REQUESTS_TOTAL.labels(verb).inc()
                 _ins.RPC_SERVER_RECEIVED_BYTES_TOTAL.labels(verb).inc(nbytes)
+            # dispatch span, parented on the CLIENT's span via the
+            # Request.trace_ctx extension field (getattr: a version-skewed
+            # peer's pickle lacks it — skew means "no trace", never an
+            # AttributeError). The handler runs on this thread, so engine/
+            # backend spans inside it parent here via the thread-local
+            # stack, joining the caller's trace across the process boundary.
+            ctx = getattr(request, "trace_ctx", None)
+            span = _tracing.start_span(
+                _tracing.SPAN_RPC_SERVER,
+                parent_ctx=ctx if isinstance(ctx, dict) else None,
+                method=verb,
+            )
+            _flight.record("rpc.dispatch", verb)
             if fn is None:
                 reply = {"id": call_id, "error": f"unknown method: {method!r}"}
             else:
                 try:
-                    reply = {"id": call_id, "result": fn(request)}
+                    result = fn(request)
+                    if span is not None and isinstance(result, Response):
+                        # reply-side context: lets the client link its
+                        # round-trip span to this handler span
+                        result.trace_ctx = span.ctx()
+                    reply = {"id": call_id, "result": result}
                 except Exception as e:  # error crosses the wire, like net/rpc
-                    reply = {"id": call_id, "error": f"{type(e).__name__}: {e}"}
-            if "error" in reply and _metrics.enabled():
-                _ins.RPC_SERVER_ERRORS_TOTAL.labels(verb).inc()
+                    # structured: the exception CLASS and raise site cross
+                    # too (truncated), so a worker-side failure reaching
+                    # the controller is attributable without server logs;
+                    # old clients just ignore the extra envelope keys
+                    reply = {
+                        "id": call_id,
+                        "error": f"{type(e).__name__}: {e}",
+                        "error_kind": type(e).__name__,
+                        "error_traceback": traceback.format_exc()[
+                            -_TRACEBACK_LIMIT:
+                        ],
+                    }
+                    _flight.record(
+                        "rpc.error", verb, error_kind=type(e).__name__,
+                        message=str(e)[:200],
+                    )
+            if "error" in reply:
+                if _metrics.enabled():
+                    _ins.RPC_SERVER_ERRORS_TOTAL.labels(verb).inc()
+                _tracing.end_span(span, error_kind=reply.get("error_kind"))
+            else:
+                _tracing.end_span(span)
             try:
                 with write_lock:
                     sent = send_frame(conn, reply)

@@ -114,12 +114,27 @@ def main(argv=None) -> None:
         help="enable the metrics registry (obs/), served live by the "
              "read-only GameOfLifeOperations.Status verb",
     )
+    parser.add_argument(
+        "-trace", action="store_true", default=False,
+        help="enable the span tracer + flight recorder (obs/): Update "
+             "dispatch spans join the broker's trace via Request.trace_ctx "
+             "and ship back in Status replies",
+    )
     args = parser.parse_args(argv)
     if args.metrics:
         from ..obs import metrics
 
         metrics.enable()
     server, service = serve(args.port, args.host)
+    if args.trace:
+        # after serve(): the BOUND port (not a requested 0) distinguishes
+        # multiple workers' Chrome tracks; serve only binds the socket, so
+        # no span can be recorded before the name is set
+        from ..obs import flight, tracing
+
+        tracing.enable()
+        tracing.set_process_name(f"worker:{server.port}")
+        flight.enable()
     print(f"worker listening on :{server.port}", flush=True)
     service.quit_event.wait()
 

@@ -15,15 +15,25 @@ import time
 
 @contextlib.contextmanager
 def trace(log_dir="trace_out"):
-    """Context manager: profile everything inside into ``log_dir``."""
+    """Context manager: profile everything inside into ``log_dir``.
+
+    ``start_trace`` lives INSIDE the try and ``stop_trace`` only runs once
+    it succeeded: a body that raises must still stop the profiler (or the
+    next ``trace()`` would find one already running), while a
+    ``start_trace`` failure must not be followed by a ``stop_trace`` on a
+    never-started profiler (which raises its own error and masks the
+    original one)."""
     import jax
 
     pathlib.Path(log_dir).mkdir(parents=True, exist_ok=True)
-    jax.profiler.start_trace(str(log_dir))
+    started = False
     try:
+        jax.profiler.start_trace(str(log_dir))
+        started = True
         yield pathlib.Path(log_dir)
     finally:
-        jax.profiler.stop_trace()
+        if started:
+            jax.profiler.stop_trace()
 
 
 class TurnsPerSecond:

@@ -312,9 +312,10 @@ def test_auto_checkpoint_stamps_active_plane_rule(tmp_path):
 
 
 def test_cli_rule_and_trace(tmp_path):
-    """`-rule B36/S23` evolves HighLife (PGM matches the numpy oracle)
-    and `-trace DIR` leaves a jax.profiler trace behind — the reference's
-    TestTrace role (trace_test.go:12-29) on the CLI."""
+    """`-rule B36/S23` evolves HighLife (PGM matches the numpy oracle),
+    `-trace-device DIR` leaves a jax.profiler trace behind — the
+    reference's TestTrace role (trace_test.go:12-29) on the CLI — and
+    `-trace` leaves the Chrome span trace beside the output PGM."""
     import os
     import subprocess
     import sys
@@ -334,7 +335,8 @@ def test_cli_rule_and_trace(tmp_path):
     r = subprocess.run(
         [sys.executable, "-m", "gol_distributed_final_tpu",
          "-w", "64", "-h", "64", "-turns", "30", "-noVis",
-         "-rule", "B36/S23", "-trace", str(tmp_path / "tr")],
+         "-rule", "B36/S23", "-trace",
+         "-trace-device", str(tmp_path / "tr")],
         capture_output=True, text=True, timeout=240, env=env, cwd=tmp_path,
     )
     assert r.returncode == 0, r.stdout + r.stderr
@@ -344,7 +346,12 @@ def test_cli_rule_and_trace(tmp_path):
         want = vector_step(want, birth=(3, 6), survive=(2, 3))
     np.testing.assert_array_equal(got, want)
     trace_files = list((tmp_path / "tr").rglob("*"))
-    assert any(f.is_file() for f in trace_files), "no trace written"
+    assert any(f.is_file() for f in trace_files), "no device trace written"
+    import json as _json
+
+    span_doc = _json.loads((tmp_path / "out" / "trace_64x64x30.json").read_text())
+    cats = {e.get("cat") for e in span_doc["traceEvents"] if e["ph"] == "X"}
+    assert "controller.session" in cats and "engine.chunk" in cats
 
     # -rule + -resume is rejected up front (the checkpoint's rule wins)
     r2 = subprocess.run(

@@ -156,7 +156,10 @@ def test_reregistration_is_idempotent_but_signature_checked():
 def _strip_extensions(req: Request) -> Request:
     """Simulate an older client: its pickled Request simply lacks the
     extension fields, so the server-side attribute is MISSING, not 0."""
-    for field in ("halo_depth", "rulestring", "initial_turn", "include_world"):
+    for field in (
+        "halo_depth", "rulestring", "initial_turn", "include_world",
+        "trace_ctx",
+    ):
         del req.__dict__[field]
     return req
 

@@ -1,0 +1,456 @@
+"""Distributed-tracing + flight-recorder tests (obs/tracing.py, obs/flight.py):
+the cross-process trace over a live broker+worker subprocess pair, the
+Chrome trace-event export schema, ring wraparound, dump-on-exception, the
+structured RPC error replies, version-skew pickles without ``trace_ctx``,
+the no-op path, and the span-name lint.
+"""
+
+import json
+import queue
+
+import numpy as np
+import pytest
+
+from gol_distributed_final_tpu import Params, run
+from gol_distributed_final_tpu.io.pgm import read_board
+from gol_distributed_final_tpu.obs import flight as obs_flight
+from gol_distributed_final_tpu.obs import tracing as obs_tracing
+from gol_distributed_final_tpu.obs.flight import FlightRecorder
+from gol_distributed_final_tpu.obs.tracing import (
+    Tracer,
+    to_chrome_trace,
+    write_chrome_trace,
+)
+from gol_distributed_final_tpu.rpc.client import RemoteBroker, RpcClient, RpcError
+from gol_distributed_final_tpu.rpc.protocol import Methods, Request
+
+from helpers import REPO_ROOT
+from test_rpc import _spawn, _wait_listening
+
+# the keys Perfetto's trace-event importer requires on a complete event
+PERFETTO_KEYS = ("ph", "ts", "pid", "tid", "name")
+
+
+@pytest.fixture
+def live_tracing():
+    """Enable the process-global tracer + flight recorder for one test,
+    zeroed before and disabled+zeroed after — every other test must keep
+    seeing the one-flag-check no-op default."""
+    tr, fr = obs_tracing.tracer(), obs_flight.recorder()
+    tr.reset()
+    fr.reset()
+    obs_tracing.enable()
+    obs_tracing.set_process_name("controller")
+    obs_flight.enable()
+    yield tr
+    obs_tracing.enable(False)
+    obs_flight.enable(False)
+    obs_tracing.set_process_name("")
+    tr.reset()
+    fr.reset()
+
+
+# -- unit: tracer semantics ---------------------------------------------------
+
+
+def test_span_parenting_and_ring():
+    t = Tracer(enabled=True, capacity=4)
+    with t.span("outer") as outer:
+        assert t.current_ctx()["span_id"] == outer.span_id
+        with t.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+    spans = t.snapshot()
+    assert [s["name"] for s in spans] == ["inner", "outer"]  # close order
+    # ring wraparound: capacity 4 keeps only the newest 4
+    for i in range(10):
+        t.end_span(t.start_span(f"s{i}"))
+    assert [s["name"] for s in t.snapshot()] == ["s6", "s7", "s8", "s9"]
+
+
+def test_explicit_parent_ctx_crosses_threads():
+    """The wire/pool form: an explicit parent context joins the trace even
+    where the thread-local stack is empty (RPC server, scatter pool)."""
+    import threading
+
+    t = Tracer(enabled=True)
+    root = t.start_span("root")
+    ctx = root.ctx()
+    done = threading.Event()
+
+    def worker():
+        t.end_span(t.start_span("child", parent_ctx=ctx))
+        done.set()
+
+    threading.Thread(target=worker).start()
+    assert done.wait(5)
+    t.end_span(root)
+    child, root_rec = t.snapshot()
+    assert child["trace_id"] == root_rec["trace_id"]
+    assert child["parent_id"] == root_rec["span_id"]
+
+
+def test_unsampled_trace_records_nothing_but_propagates():
+    t = Tracer(enabled=True)
+    t.sample_rate = 0.0
+    root = t.start_span("root")
+    assert root is not None and not root.sampled
+    # the decision propagates: a child under an unsampled context is
+    # unsampled too (remote processes won't record either)
+    child = t.start_span("child", parent_ctx=root.ctx())
+    t.end_span(child)
+    t.end_span(root)
+    assert t.snapshot() == []
+
+
+def test_chrome_export_schema_and_tracks():
+    """Every exported event carries the Perfetto-required keys; span
+    records from several processes become distinct named tracks."""
+    spans = [
+        {
+            "name": "rpc.client.call", "trace_id": "t1", "span_id": f"s{i}",
+            "parent_id": "", "pid": 100 + i, "tid": 1, "role": role,
+            "ts_us": 1000 * i, "dur_us": 500,
+            "args": {"method": "Operations.Run"},
+        }
+        for i, role in enumerate(["controller", "broker", "worker:1"])
+    ]
+    doc = to_chrome_trace(spans)
+    assert set(doc) == {"traceEvents", "displayTimeUnit"}
+    for ev in doc["traceEvents"]:
+        for key in PERFETTO_KEYS:
+            assert key in ev, f"{key} missing from {ev}"
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    metas = {
+        e["args"]["name"]
+        for e in doc["traceEvents"]
+        if e["ph"] == "M" and e["name"] == "process_name"
+    }
+    assert len(xs) == 3 and all(e["dur"] >= 1 for e in xs)
+    assert metas == {"controller", "broker", "worker:1"}
+    # verb rides the display name; ids ride args for trace reassembly
+    assert xs[0]["name"] == "rpc.client.call Operations.Run"
+    assert xs[0]["args"]["trace_id"] == "t1"
+
+
+def test_disabled_tracer_is_noop_without_allocations():
+    """The acceptance bound: with -trace off an instrumented site costs a
+    flag check — start_span returns None before ANY allocation (no Span,
+    no ids, no clock reads), measured via tracemalloc against the module."""
+    import tracemalloc
+
+    assert not obs_tracing.enabled()
+    assert obs_tracing.start_span(obs_tracing.SPAN_ENGINE_CHUNK) is None
+    assert obs_tracing.current_ctx() is None
+    obs_tracing.end_span(None)  # None-safe
+    tracemalloc.start()
+    try:
+        obs_tracing.start_span(obs_tracing.SPAN_ENGINE_CHUNK)  # warm
+        before = tracemalloc.take_snapshot()
+        for _ in range(200):
+            obs_tracing.end_span(
+                obs_tracing.start_span(obs_tracing.SPAN_ENGINE_CHUNK)
+            )
+            obs_flight.record("rpc.send", "Operations.Run")
+        after = tracemalloc.take_snapshot()
+    finally:
+        tracemalloc.stop()
+    grown = [
+        stat
+        for stat in after.compare_to(before, "filename")
+        if stat.size_diff > 0
+        and stat.traceback[0].filename
+        in (obs_tracing.__file__, obs_flight.__file__)
+    ]
+    assert not grown, f"disabled-path allocations: {grown}"
+    assert obs_tracing.tracer().snapshot() == []
+    assert obs_flight.recorder().snapshot() == []
+
+
+# -- unit: flight recorder ----------------------------------------------------
+
+
+def test_flight_ring_wraparound_and_dump(tmp_path):
+    fr = FlightRecorder(capacity=8, enabled=True)
+    for i in range(20):
+        fr.record("rpc.send", f"verb{i}", i=i)
+    events = fr.snapshot()
+    assert len(events) == 8  # bounded
+    assert [e["args"]["i"] for e in events] == list(range(12, 20))  # newest
+    assert [e["seq"] for e in events] == list(range(13, 21))  # seq never resets
+    path = fr.dump(tmp_path / "flight_test.jsonl")
+    lines = [json.loads(line) for line in path.read_text().splitlines()]
+    assert len(lines) == 8 and lines[-1]["name"] == "verb19"
+
+
+def test_engine_crash_dumps_flight_ring(tmp_path, live_tracing):
+    """An unhandled engine exception leaves out/flight_<host>.jsonl behind,
+    ending with the crash event — the post-mortem the hang/crash class of
+    bug otherwise destroys."""
+    from gol_distributed_final_tpu.engine.engine import Engine
+
+    obs_flight.set_dump_dir(tmp_path)
+    try:
+        def boom(board, n):
+            raise RuntimeError("kernel exploded")
+
+        p = Params(turns=4, threads=8, image_width=16, image_height=16)
+        board = read_board(p, REPO_ROOT / "images")
+        with pytest.raises(RuntimeError, match="kernel exploded"):
+            Engine().run(p, board, step_n_fn=boom)
+    finally:
+        obs_flight.set_dump_dir("out")
+    path = obs_flight.crash_dump_path(tmp_path)
+    assert path.exists()
+    lines = [json.loads(line) for line in path.read_text().splitlines()]
+    assert lines[-1]["kind"] == "crash"
+    assert lines[-1]["name"] == "RuntimeError"
+    assert "kernel exploded" in lines[-1]["args"]["message"]
+
+
+def test_flight_disabled_records_and_dumps_nothing(tmp_path):
+    assert not obs_flight.enabled()
+    obs_flight.record("rpc.send", "x")
+    assert obs_flight.recorder().snapshot() == []
+    assert obs_flight.dump_on_crash(RuntimeError("x"), tmp_path) is None
+    assert not list(tmp_path.iterdir())
+
+
+# -- the utils/trace.py guard fix ---------------------------------------------
+
+
+def test_profiler_trace_stops_when_body_raises(tmp_path, monkeypatch):
+    import types
+
+    import jax
+
+    from gol_distributed_final_tpu.utils.trace import trace
+
+    calls = []
+    monkeypatch.setattr(
+        jax, "profiler", types.SimpleNamespace(
+            start_trace=lambda d: calls.append("start"),
+            stop_trace=lambda: calls.append("stop"),
+        ),
+    )
+    with pytest.raises(RuntimeError, match="body"):
+        with trace(tmp_path / "tr"):
+            raise RuntimeError("body")
+    assert calls == ["start", "stop"], "a raising body must still stop"
+
+
+def test_profiler_trace_start_failure_skips_stop(tmp_path, monkeypatch):
+    import types
+
+    import jax
+
+    from gol_distributed_final_tpu.utils.trace import trace
+
+    calls = []
+
+    def bad_start(d):
+        calls.append("start")
+        raise OSError("profiler unavailable")
+
+    monkeypatch.setattr(
+        jax, "profiler", types.SimpleNamespace(
+            start_trace=bad_start,
+            stop_trace=lambda: calls.append("stop"),
+        ),
+    )
+    with pytest.raises(OSError, match="profiler unavailable"):
+        with trace(tmp_path / "tr"):
+            pass  # pragma: no cover - never reached
+    assert calls == ["start"], "stop on a never-started profiler masks the error"
+
+
+# -- structured RPC error replies ---------------------------------------------
+
+
+def test_rpc_error_carries_kind_and_remote_traceback():
+    """A handler-side failure names the exception class and raise site in
+    the reply (RpcError.kind / .remote_traceback), instead of only an
+    opaque message string."""
+    from gol_distributed_final_tpu.rpc.broker import serve
+
+    server, service = serve(port=0)
+    client = RpcClient(f"127.0.0.1:{server.port}")
+    try:
+        bad = Request(
+            world=np.zeros((8, 8), np.uint8), turns=4,
+            image_width=16, image_height=16,  # shape mismatch -> ValueError
+        )
+        with pytest.raises(RpcError) as err:
+            client.call(Methods.BROKER_RUN, bad)
+        assert err.value.kind == "ValueError"
+        assert "does not match params" in str(err.value)
+        # the traceback tail names the raise site, truncated server-side
+        assert "broker.py" in err.value.remote_traceback
+        assert len(err.value.remote_traceback) <= 2000
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_rpc_error_without_structured_fields_degrades(monkeypatch):
+    """An OLD server's error reply has no error_kind/error_traceback keys:
+    the client must surface a plain RpcError with kind None."""
+    err = RpcError("boom")
+    assert err.kind is None and err.remote_traceback is None
+
+
+def test_flight_records_rpc_error(live_tracing):
+    from gol_distributed_final_tpu.rpc.broker import serve
+
+    server, service = serve(port=0)
+    client = RpcClient(f"127.0.0.1:{server.port}")
+    try:
+        with pytest.raises(RpcError):
+            client.call(Methods.BROKER_RUN, Request(turns=-1))
+    finally:
+        client.close()
+        server.stop()
+    kinds = {(e["kind"], e["name"]) for e in obs_flight.recorder().snapshot()}
+    # both ends run in this process: the server-side structured error
+    # record and the client-side failed-receive record
+    assert ("rpc.error", Methods.BROKER_RUN) in kinds
+    assert ("rpc.recv", Methods.BROKER_RUN) in kinds
+
+
+# -- version skew -------------------------------------------------------------
+
+
+def test_request_pickle_without_trace_ctx_is_served():
+    """A version-skewed client's Request pickle predates trace_ctx: a
+    TRACING server must read it via getattr and serve the default (no
+    trace), never an AttributeError reply."""
+    broker = _spawn(
+        "gol_distributed_final_tpu.rpc.broker", "-port", "0", "-trace"
+    )
+    try:
+        port = _wait_listening(broker)
+        client = RpcClient(f"127.0.0.1:{port}")
+        try:
+            p = Params(turns=4, threads=8, image_width=16, image_height=16)
+            board = read_board(p, REPO_ROOT / "images")
+            req = Request(
+                world=board, turns=4, image_width=16, image_height=16
+            )
+            del req.__dict__["trace_ctx"]  # the old client's pickle shape
+            res = client.call(Methods.BROKER_RUN, req)
+            assert res.turns_completed == 4
+            # the reply from a tracing server still carries ITS span ctx
+            # (harmless to an old client, linkable for a new one)
+            assert getattr(res, "trace_ctx", None) is not None
+        finally:
+            client.close()
+    finally:
+        if broker.poll() is None:
+            broker.kill()
+        broker.wait()
+
+
+# -- the acceptance path: live three-process trace ----------------------------
+
+
+def test_cross_process_trace_spans_share_one_trace_id(tmp_path, live_tracing):
+    """A -trace session over a live broker + 2-worker subprocess pair
+    exports a Chrome trace whose events carry the Perfetto-required keys,
+    with >= 3 distinct process tracks (controller, broker, worker) and
+    every RPC span sharing ONE trace_id — the cross-process propagation
+    contract, end to end."""
+    workers = [
+        _spawn("gol_distributed_final_tpu.rpc.worker", "-port", "0", "-trace")
+        for _ in range(2)
+    ]
+    broker = None
+    try:
+        ports = [_wait_listening(w) for w in workers]
+        addrs = ",".join(f"127.0.0.1:{p}" for p in ports)
+        broker = _spawn(
+            "gol_distributed_final_tpu.rpc.broker",
+            "-port", "0", "-backend", "workers", "-workers", addrs, "-trace",
+        )
+        broker_port = _wait_listening(broker)
+        remote = RemoteBroker(f"127.0.0.1:{broker_port}")
+        try:
+            p = Params(turns=10, threads=2, image_width=16, image_height=16)
+            result = run(
+                p,
+                queue.Queue(),
+                broker=remote,
+                images_dir=REPO_ROOT / "images",
+                out_dir=tmp_path / "out",
+                tick_seconds=3600.0,
+            )
+            assert result.turns_completed == 10
+
+            # the broker's Status also snapshots its flight ring — the
+            # live post-mortem surface for a wedged run
+            from gol_distributed_final_tpu.obs.status import fetch_status
+
+            status = fetch_status(f"127.0.0.1:{broker_port}")
+            assert status["flight"], "broker flight ring missing from Status"
+            kinds = {e["kind"] for e in status["flight"]}
+            assert "rpc.dispatch" in kinds
+        finally:
+            remote.close()
+    finally:
+        for proc in (*workers, *([broker] if broker else [])):
+            if proc.poll() is None:
+                proc.kill()
+            proc.wait()
+
+    doc = json.loads((tmp_path / "out" / "trace_16x16x10.json").read_text())
+    events = doc["traceEvents"]
+    for ev in events:
+        for key in PERFETTO_KEYS:
+            assert key in ev, f"{key} missing from {ev}"
+    spans = [e for e in events if e["ph"] == "X"]
+    tracks = {
+        e["args"]["name"]
+        for e in events
+        if e["ph"] == "M" and e["name"] == "process_name"
+    }
+    assert "controller" in tracks and "broker" in tracks
+    assert sum(1 for t in tracks if t.startswith("worker")) == 2
+    assert len({e["pid"] for e in spans}) >= 3
+    # the acceptance criterion: RPC spans (client AND server side, all
+    # three processes) share one trace_id — and here the whole session does
+    rpc_ids = {
+        e["args"]["trace_id"] for e in spans if e["cat"].startswith("rpc.")
+    }
+    assert len(rpc_ids) == 1
+    assert {e["args"]["trace_id"] for e in spans} == rpc_ids
+    # every layer made it onto the timeline: session root, broker verbs,
+    # per-worker Update strips, per-turn scatter/gather
+    cats = {e["cat"] for e in spans}
+    assert {
+        "controller.session", "rpc.client.call", "rpc.server.dispatch",
+        "broker.turn",
+    } <= cats
+
+
+# -- tooling ------------------------------------------------------------------
+
+
+def test_every_declared_span_name_is_documented():
+    from gol_distributed_final_tpu.obs.lint import undocumented_spans
+
+    assert undocumented_spans() == []
+
+
+def test_in_process_session_exports_trace(tmp_path, live_tracing):
+    """-trace without a remote broker: the in-process engine's chunk spans
+    land in the same export under the controller's own pid."""
+    p = Params(turns=8, threads=8, image_width=16, image_height=16)
+    run(
+        p,
+        queue.Queue(),
+        images_dir=REPO_ROOT / "images",
+        out_dir=tmp_path / "out",
+        tick_seconds=3600.0,
+    )
+    doc = json.loads((tmp_path / "out" / "trace_16x16x8.json").read_text())
+    cats = {e["cat"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert {"controller.session", "engine.chunk"} <= cats
