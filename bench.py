@@ -79,6 +79,36 @@ class InvalidMeasurement(RuntimeError):
     """A fit that must not be published (non-positive or noise-dominated)."""
 
 
+def provenance() -> dict:
+    """Environment stamp for the JSON line: jax version, device fleet, and
+    git SHA. ``scripts/bench_diff`` (obs/regress.py) refuses to compare
+    rounds whose jax version or device kind/count differ — a number from
+    a different chip is not a regression. Each field degrades to None
+    rather than failing the bench that exists to publish numbers."""
+    out = {"jax_version": None, "platform": None, "device_kind": None,
+           "device_count": None, "git_sha": None}
+    try:
+        import jax
+
+        out["jax_version"] = jax.__version__
+        devs = jax.devices()
+        out["platform"] = devs[0].platform if devs else None
+        out["device_kind"] = getattr(devs[0], "device_kind", None) if devs else None
+        out["device_count"] = len(devs)
+    except Exception:
+        pass
+    try:
+        import subprocess
+
+        out["git_sha"] = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or None
+    except Exception:
+        pass
+    return out
+
+
 def marginal(time_fn, n_lo, n_hi, label="?"):
     """Per-run-unit marginal cost between n_lo and n_hi, with variance.
 
@@ -425,6 +455,9 @@ def _bench_body() -> int:
                 "value": headline,
                 "unit": "cell-updates/s",
                 "vs_baseline": headline / BASELINE_CELL_UPDATES_PER_SEC,
+                # environment stamp: bench_diff refuses cross-environment
+                # comparisons (obs/regress.py)
+                "provenance": provenance(),
                 "extra": extra,
             }
         )

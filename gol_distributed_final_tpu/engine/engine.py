@@ -38,6 +38,7 @@ _PIPELINE_DEPTH = 3
 
 from ..events import CellFlipped, TurnComplete
 from ..models import CONWAY, LifeRule
+from ..obs import device as _device
 from ..obs import flight as _flight
 from ..obs import instruments as _ins
 from ..obs import metrics as _metrics
@@ -394,6 +395,11 @@ class Engine:
                     _ins.ENGINE_TURNS_TOTAL.inc(n)
                     _ins.ENGINE_CHUNKS_TOTAL.inc()
                     _ins.ENGINE_CHUNK_SIZE.set(chunk)
+                    # per-chunk HBM occupancy (obs/device.py): the gauges
+                    # that bound a TPU run, live on the Status verb and
+                    # the watch dashboard; one cached early-return on
+                    # backends without memory stats (CPU)
+                    _device.sample_hbm()
                 if growing:
                     if multihost:
                         # the wall-clock cap is rank-local: unagreed it
@@ -453,6 +459,11 @@ class Engine:
 
                 every = self.config.checkpoint_every
                 if every and turn_now // every > (turn_now - n) // every:
+                    # HBM sample at EVERY checkpoint, metrics on or off:
+                    # advances the peak-observed high-water mark the
+                    # RunReport publishes, so a mid-run spike is visible
+                    # in the final artifact (obs/report.device_inventory)
+                    _device.sample_hbm()
                     t_ckpt = time.monotonic()
                     ckpt_span = _tracing.start_span(
                         _tracing.SPAN_ENGINE_CHECKPOINT, turn=turn_now

@@ -7,7 +7,7 @@ our port adds the ``jax.profiler`` shim in ``utils/trace.py``. Neither says
 transfer vs. RPC — which is the first question every perf round asks
 (BENCH_r*.json measures only end-to-end time).
 
-This package is the answer, in five parts:
+This package is the answer, in eight parts:
 
 * ``metrics``     — a dependency-free registry (counters, gauges,
                     fixed-bucket histograms) with JSON and Prometheus-text
@@ -30,7 +30,20 @@ This package is the answer, in five parts:
                     of the last structured events (span open/close, RPC
                     send/recv, checkpoint votes), shipped in ``Status``
                     replies and dumped to ``out/flight_<host>.jsonl`` on
-                    unhandled engine exceptions.
+                    unhandled engine exceptions;
+* ``device``      — XLA-level telemetry: timed explicit lower/compile with
+                    ``cost_analysis`` (FLOPs, bytes accessed) at every
+                    kernel compile site, and per-device ``memory_stats``
+                    HBM gauges sampled per turn-chunk (null-guarded on
+                    CPU) with a process-local peak high-water mark;
+* ``watch``       — the live terminal dashboard: polls broker/worker
+                    ``Status`` and renders throughput, RPC latency,
+                    compile-cache hit rate, HBM, and the flight tail — a
+                    cluster ``top`` on the read-only verb;
+* ``regress``     — the noise-aware perf-regression gate over two bench
+                    JSON outputs (``scripts/bench_diff``): per-case
+                    verdicts using each case's recorded endpoint spread,
+                    provenance-checked, nonzero exit past the threshold.
 
 Everything is process-local and OFF by default: with metrics and tracing
 disabled each instrument call is a flag check, so the hot paths cost

@@ -1,0 +1,294 @@
+"""XLA-level device telemetry: compile wall time, kernel cost analysis,
+and per-device HBM gauges.
+
+The host-side registry (obs/metrics.py) answers "where did WALL time go";
+this module answers the three questions that actually bound a TPU stack
+and were invisible until something OOM'd:
+
+* **How long did each compile take?** Every instrumented compile site goes
+  through an explicit ``lower() -> compile()`` with the wall clock around
+  it (``gol_compile_seconds{site}``), instead of paying the compile
+  silently inside the first dispatch.
+* **What does the compiled program cost?** ``Lowered.cost_analysis()``
+  gives XLA's own FLOP and bytes-accessed estimates for the program
+  (``gol_kernel_flops{site}`` / ``gol_kernel_bytes_accessed{site}``) — the
+  roofline inputs, per kernel site, without a profiler run.
+* **How close is HBM to the ceiling?** ``Device.memory_stats()`` sampled
+  per turn-chunk in the engine (``gol_device_hbm_bytes_in_use{device}``,
+  ``..._peak_bytes``, ``..._bytes_limit``) plus a process-local high-water
+  mark (``hbm_peak_observed``) that the RunReport publishes, so a mid-run
+  spike is visible even after it subsides.
+
+Everything flows through the existing registry, so the numbers ride the
+``Status`` verb, the RunReport, Prometheus exposition, and the live watch
+dashboard (obs/watch.py) with no new plumbing.
+
+Guards, in the same spirit as obs/report.py's device inventory: jax is
+imported lazily (this module must stay importable from jax-free
+processes); a backend without ``memory_stats`` (CPU) is discovered ONCE
+and sampling becomes a near-free early return; any failure inside the
+AOT lower/compile path falls back to the plain jitted call — telemetry
+must never change what executes, only observe it.
+
+Instrumentation sites are a stable, low-cardinality label set (README
+"Device telemetry" table; obs/lint.py):
+
+    pallas.vmem_byte   whole-board VMEM byte kernel   (ops/pallas_stencil)
+    pallas.vmem_bit    whole-board VMEM bit kernel    (ops/pallas_stencil)
+    pallas.tiled       grid-tiled bit kernel          (ops/pallas_tiled)
+    bitpack.xla_step   XLA bitboard fori_loop step    (ops/bitpack, ops/plane)
+    halo.byte          byte-plane mesh step           (parallel/halo)
+    halo.bit           bit-plane mesh step            (parallel/bit_halo)
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from . import instruments as _ins
+from . import metrics as _metrics
+
+# sentinel distinct from None ("AOT failed / decided plain"), so a key's
+# first-call decision is taken exactly once
+_UNSEEN = object()
+
+# jax.core.Tracer, resolved lazily on first use (this module must import
+# without jax)
+_TRACER_CLS = None
+
+
+def _is_traced(args) -> bool:
+    """True when any argument is a jax tracer — the call site is being
+    TRACED into an enclosing program (e.g. the tiled kernel inside
+    shard_map), where an AOT lower/compile of the inner function would be
+    a wasted standalone compile. Such calls pass straight through."""
+    global _TRACER_CLS
+    if _TRACER_CLS is None:
+        try:
+            from jax.core import Tracer as _TRACER_CLS  # noqa: F811
+        except Exception:
+            return False
+    return any(isinstance(a, _TRACER_CLS) for a in args)
+
+# (site, id(jitted), abstract key) -> Compiled | None for compile_and_call
+_CALL_CACHE: Dict[tuple, object] = {}
+
+# per-device high-water mark of bytes_in_use, across every sample this
+# process ever took — what the RunReport publishes as the peak SEEN, not
+# just the peak at the final sample
+_PEAK_OBSERVED: Dict[str, int] = {}
+_PEAK_LOCK = threading.Lock()
+
+# tri-state discovery: None = never probed, False = backend has no
+# memory_stats (CPU) — later samples return immediately, True = supported
+_HBM_SUPPORTED: Optional[bool] = None
+
+
+def _abstract_key(args) -> tuple:
+    """Hashable (shape, dtype) signature of a call — arrays by aval,
+    non-array statics by value (they select the compiled program)."""
+    key = []
+    for a in args:
+        shape = getattr(a, "shape", None)
+        if shape is not None:
+            key.append((tuple(shape), str(getattr(a, "dtype", ""))))
+        else:
+            key.append(a)
+    return tuple(key)
+
+
+def _timed_compile(site: str, jitted, args):
+    """Explicit AOT lower+compile with the wall clock around it, recording
+    compile seconds and the lowered cost analysis. Returns the Compiled
+    executable, or None if anything failed (caller falls back to the
+    plain jitted call — which re-raises any REAL compile error)."""
+    try:
+        t0 = time.monotonic()
+        lowered = jitted.lower(*args)
+        compiled = lowered.compile()
+        _ins.COMPILE_SECONDS.labels(site).observe(time.monotonic() - t0)
+    except Exception:
+        return None
+    try:
+        ca = lowered.cost_analysis()
+        # older jax versions return a per-device list, newer a flat dict
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if hasattr(ca, "get"):
+            flops = ca.get("flops")
+            if flops is not None:
+                _ins.KERNEL_FLOPS.labels(site).set(flops)
+            accessed = ca.get("bytes accessed")
+            if accessed is not None:
+                _ins.KERNEL_BYTES_ACCESSED.labels(site).set(accessed)
+    except Exception:
+        pass  # cost analysis is best-effort; the compile already counted
+    return compiled
+
+
+def instrument_jit(site: str, jitted):
+    """Wrap a jitted callable so its FIRST call per argument signature goes
+    through a timed explicit lower/compile (+ cost analysis), and every
+    later call hits the cached executable directly.
+
+    The first call for each signature decides ONCE: with the registry
+    enabled it takes the AOT path; disabled, it pins that signature to the
+    plain jit path (so enabling metrics later never triggers a duplicate
+    compile of an already-compiled program). Any AOT failure — lower,
+    compile, or a mismatched executable call — falls back to the plain
+    jitted call, which re-raises real errors with their original type
+    (the BitPlane VMEM-gate fallback depends on that)."""
+    if getattr(jitted, "lower", None) is None:
+        return jitted  # duck-typed fake or plain fn: nothing to instrument
+    cache: Dict[tuple, object] = {}
+
+    def call(*args):
+        if _is_traced(args):
+            return jitted(*args)  # inlining into an enclosing trace
+        key = _abstract_key(args)
+        entry = cache.get(key, _UNSEEN)
+        if entry is _UNSEEN:
+            if not _metrics.enabled():
+                cache[key] = None
+                return jitted(*args)
+            entry = _timed_compile(site, jitted, args)
+            cache[key] = entry
+            if entry is None:
+                return jitted(*args)
+        if entry is None:
+            return jitted(*args)
+        try:
+            return entry(*args)
+        except (TypeError, ValueError):
+            # the executable's ARGUMENT checks (input pytree / committed
+            # sharding mismatch) reject before anything runs: route this
+            # signature to the plain jit path rather than fail dispatch
+            # over telemetry. Runtime failures (XlaRuntimeError, OOM)
+            # propagate as-is — re-running a failing multi-second program
+            # through the fallback would double time-to-failure and drop
+            # the original traceback.
+            cache[key] = None
+            return jitted(*args)
+
+    call.__wrapped__ = jitted
+    return call
+
+
+def compile_and_call(site: str, jitted, *args, static_argnums=()):
+    """One-shot form of ``instrument_jit`` for direct call sites of a
+    module-level jitted function (e.g. ``bitpack.bit_step_n``): same
+    decide-once-per-signature semantics through a module-global cache.
+
+    ``static_argnums`` must mirror the jitted function's own — an AOT
+    executable is called WITHOUT its static arguments (they are burned
+    into the program)."""
+    if _is_traced(args):
+        return jitted(*args)  # inlining into an enclosing trace
+    key = (site, id(jitted), _abstract_key(args))
+    entry = _CALL_CACHE.get(key, _UNSEEN)
+    if entry is _UNSEEN:
+        if not _metrics.enabled() or getattr(jitted, "lower", None) is None:
+            _CALL_CACHE[key] = None
+            return jitted(*args)
+        entry = _timed_compile(site, jitted, args)
+        _CALL_CACHE[key] = entry
+        if entry is None:
+            return jitted(*args)
+    if entry is None:
+        return jitted(*args)
+    dynamic = tuple(a for i, a in enumerate(args) if i not in static_argnums)
+    try:
+        return entry(*dynamic)
+    except (TypeError, ValueError):
+        # argument-check rejection only — runtime failures propagate
+        # (see instrument_jit's call path for the rationale)
+        _CALL_CACHE[key] = None
+        return jitted(*args)
+
+
+# -- HBM sampling -------------------------------------------------------------
+
+
+def sample_hbm(devices=None) -> Dict[str, dict]:
+    """One ``memory_stats()`` sweep over the local devices: sets the HBM
+    gauges and advances the process-local peak-observed high-water mark.
+
+    Returns ``{device_id: {bytes_in_use, peak_bytes_in_use, bytes_limit}}``
+    — empty on a backend without memory stats (CPU returns None, like the
+    guarded null in obs/report.device_inventory). The unsupported
+    discovery is cached, so the engine can call this per turn-chunk and a
+    CPU run pays one probe total. ``devices`` overrides the
+    ``jax.local_devices()`` default (the test hook)."""
+    global _HBM_SUPPORTED
+    probed_default = devices is None
+    if probed_default:
+        if _HBM_SUPPORTED is False:
+            return {}
+        try:
+            import jax
+
+            devices = jax.local_devices()
+        except Exception:
+            _HBM_SUPPORTED = False
+            return {}
+    out: Dict[str, dict] = {}
+    for dev in devices:
+        try:
+            stats = dev.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        label = str(getattr(dev, "id", len(out)))
+        in_use = stats.get("bytes_in_use")
+        peak = stats.get("peak_bytes_in_use", in_use)
+        limit = stats.get("bytes_limit")
+        if in_use is not None:
+            _ins.HBM_BYTES_IN_USE.labels(label).set(in_use)
+            with _PEAK_LOCK:
+                _PEAK_OBSERVED[label] = max(
+                    _PEAK_OBSERVED.get(label, 0), int(in_use)
+                )
+        if peak is not None:
+            _ins.HBM_PEAK_BYTES.labels(label).set(peak)
+            with _PEAK_LOCK:
+                _PEAK_OBSERVED[label] = max(
+                    _PEAK_OBSERVED.get(label, 0), int(peak)
+                )
+        if limit is not None:
+            _ins.HBM_BYTES_LIMIT.labels(label).set(limit)
+        out[label] = {
+            "bytes_in_use": in_use,
+            "peak_bytes_in_use": peak,
+            "bytes_limit": limit,
+        }
+    if probed_default:
+        # an explicit device list (the test hook) never writes the
+        # discovery — only a real local_devices() probe decides it. The
+        # latch is one-way up: the FIRST probe may declare the backend
+        # unsupported (CPU), but once a sweep has produced stats, a
+        # transient all-devices-failed sweep must not silently disable
+        # every future sample (the gauges would freeze mid-run).
+        if out:
+            _HBM_SUPPORTED = True
+        elif _HBM_SUPPORTED is None:
+            _HBM_SUPPORTED = False
+    return out
+
+
+def hbm_peak_observed() -> Dict[str, int]:
+    """Per-device high-water ``bytes_in_use`` across every sample this
+    process took — what the RunReport publishes so a mid-run spike is
+    visible in the final artifact even after it subsides."""
+    with _PEAK_LOCK:
+        return dict(_PEAK_OBSERVED)
+
+
+def reset_hbm() -> None:
+    """Forget peaks and the supported/unsupported discovery (tests)."""
+    global _HBM_SUPPORTED
+    with _PEAK_LOCK:
+        _PEAK_OBSERVED.clear()
+    _HBM_SUPPORTED = None

@@ -166,3 +166,41 @@ HALO_EXCHANGES_TOTAL = _R.counter(
     "dispatches, by plane.",
     labelnames=("plane",),
 )
+
+# -- device / XLA telemetry (obs/device.py) ---------------------------------
+
+COMPILE_SECONDS = _R.histogram(
+    "gol_compile_seconds",
+    "Wall time of one explicit XLA lower+compile at an instrumented "
+    "compile site (obs/device.instrument_jit), by site.",
+    labelnames=("site",),
+)
+KERNEL_FLOPS = _R.gauge(
+    "gol_kernel_flops",
+    "XLA cost-analysis FLOP estimate of the most recently compiled "
+    "program at a site (Lowered.cost_analysis).",
+    labelnames=("site",),
+)
+KERNEL_BYTES_ACCESSED = _R.gauge(
+    "gol_kernel_bytes_accessed",
+    "XLA cost-analysis bytes-accessed estimate of the most recently "
+    "compiled program at a site.",
+    labelnames=("site",),
+)
+HBM_BYTES_IN_USE = _R.gauge(
+    "gol_device_hbm_bytes_in_use",
+    "Device memory in use (memory_stats bytes_in_use), sampled per "
+    "turn-chunk and at checkpoints; absent on backends without memory "
+    "stats (CPU).",
+    labelnames=("device",),
+)
+HBM_PEAK_BYTES = _R.gauge(
+    "gol_device_hbm_peak_bytes",
+    "Device-reported peak memory in use (memory_stats peak_bytes_in_use).",
+    labelnames=("device",),
+)
+HBM_BYTES_LIMIT = _R.gauge(
+    "gol_device_hbm_bytes_limit",
+    "Device memory capacity (memory_stats bytes_limit).",
+    labelnames=("device",),
+)

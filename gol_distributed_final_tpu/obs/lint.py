@@ -4,7 +4,10 @@ Two operator-facing name contracts live in this package: metric names
 (``obs/instruments.py`` — RunReports, Status payloads, Prometheus scrapes)
 and span names (``obs/tracing.py`` — Chrome trace exports, flight-recorder
 events). The README "Observability" and "Tracing" sections are their
-documentation of record. These lints fail when a name registered in code
+documentation of record; the device-telemetry families (obs/device.py)
+additionally must sit in the dedicated "Device telemetry" table, and the
+operator-facing sections themselves ("Device telemetry", "Perf regression
+gate", ...) must exist. These lints fail when a name registered in code
 is missing from the README — so adding an instrument or a span site
 without documenting it breaks the build (``tests/test_obs.py`` and
 ``tests/test_tracing.py`` run them;
@@ -48,6 +51,59 @@ def undocumented_spans(readme_path=None) -> List[str]:
     return sorted(n for n in registered_span_names() if n not in text)
 
 
+# prefixes of the device-telemetry metric families (obs/device.py): these
+# must be documented in the README's dedicated "Device telemetry" table,
+# not just anywhere in the file (gol_compile_cache_* predates obs/device
+# and lives in the main Observability table)
+_DEVICE_METRIC_PREFIXES = (
+    "gol_compile_seconds", "gol_kernel_", "gol_device_hbm_",
+)
+
+# operator-facing sections the README must keep: the doc anchors the name
+# lints point at, and the regression-gate/watch docs this package's CLIs
+# reference in their own help text
+_REQUIRED_SECTIONS = (
+    "## Observability",
+    "## Tracing",
+    "Device telemetry",
+    "Perf regression gate",
+)
+
+
+def undocumented_device_metrics(readme_path=None) -> List[str]:
+    """Device-telemetry metric names (obs/device.py's families) missing
+    from the README's "Device telemetry" section specifically — a name
+    mentioned elsewhere in the file does not count as documented here."""
+    from . import instruments  # noqa: F401 - registers every family
+    from .metrics import registry
+
+    if readme_path is None:
+        readme_path = REPO_ROOT / "README.md"
+    text = pathlib.Path(readme_path).read_text()
+    anchor = text.find("Device telemetry")
+    if anchor >= 0:
+        # bound the section at the next top-level heading: a name that
+        # only appears in a LATER section must still be flagged
+        end = text.find("\n## ", anchor)
+        section = text[anchor:] if end < 0 else text[anchor:end]
+    else:
+        section = ""
+    return sorted(
+        fam.name
+        for fam in registry().families()
+        if fam.name.startswith(_DEVICE_METRIC_PREFIXES)
+        and fam.name not in section
+    )
+
+
+def missing_readme_sections(readme_path=None) -> List[str]:
+    """Required operator-facing README sections that are absent."""
+    if readme_path is None:
+        readme_path = REPO_ROOT / "README.md"
+    text = pathlib.Path(readme_path).read_text()
+    return [s for s in _REQUIRED_SECTIONS if s not in text]
+
+
 def main(argv=None) -> int:
     rc = 0
     missing = undocumented_metrics()
@@ -74,6 +130,31 @@ def main(argv=None) -> int:
         rc = 1
     else:
         print("span-name lint ok: every declared span name is documented")
+    missing_dev = undocumented_device_metrics()
+    if missing_dev:
+        print(
+            "device metrics registered in obs/instruments.py but missing "
+            "from README.md's Device telemetry table:",
+            file=sys.stderr,
+        )
+        for name in missing_dev:
+            print(f"  {name}", file=sys.stderr)
+        rc = 1
+    else:
+        print(
+            "device-metric lint ok: every device metric is in the Device "
+            "telemetry table"
+        )
+    missing_sections = missing_readme_sections()
+    if missing_sections:
+        print(
+            "required README sections missing:", file=sys.stderr,
+        )
+        for section in missing_sections:
+            print(f"  {section}", file=sys.stderr)
+        rc = 1
+    else:
+        print("section lint ok: every required README section present")
     return rc
 
 

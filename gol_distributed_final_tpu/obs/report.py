@@ -84,11 +84,19 @@ def device_inventory() -> dict:
     """``jax.local_devices()`` identity + per-device memory stats, each
     guarded: a backend without memory_stats (CPU) reports null, and a
     failing jax import degrades to an error note instead of sinking the
-    report that exists to explain the run."""
+    report that exists to explain the run.
+
+    ``hbm_peak_observed_bytes`` is the high-water ``bytes_in_use`` across
+    every sample this process took (per turn-chunk and at every
+    checkpoint — obs/device.py), NOT just the final reading: a mid-run
+    HBM spike that subsided before FinalTurnComplete still shows here."""
     try:
         import jax
     except Exception as exc:  # pragma: no cover - jax is baked in
         return {"error": f"jax unavailable: {exc}"}
+    from . import device as _device
+
+    peaks = _device.hbm_peak_observed()
     devices = []
     for dev in jax.local_devices():
         entry = {
@@ -101,6 +109,7 @@ def device_inventory() -> dict:
             entry["memory_stats"] = dev.memory_stats()
         except Exception:
             entry["memory_stats"] = None
+        entry["hbm_peak_observed_bytes"] = peaks.get(str(dev.id))
         devices.append(entry)
     return {
         "backend": devices[0]["platform"] if devices else "none",

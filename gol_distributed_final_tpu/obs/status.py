@@ -16,8 +16,42 @@ import json
 import sys
 
 
+class StatusUnavailable(RuntimeError):
+    """The server answered, but no usable status payload came back. The
+    message distinguishes the two distinct situations an operator needs
+    to tell apart: an OLD server whose Response pickle predates the
+    ``status`` field entirely, vs a current server that replied with an
+    EMPTY payload."""
+
+
+def extract_status(res) -> dict:
+    """Classify a Status reply: the payload dict, or StatusUnavailable
+    with a message naming WHICH failure mode this is. A missing attribute
+    (old server's Response pickle) and a present-but-None field (handler
+    never populated it) are the same operator situation — no payload —
+    and share a message; an EMPTY dict is a different, current-server
+    situation and gets its own."""
+    status = getattr(res, "status", None)
+    if status is None:
+        raise StatusUnavailable(
+            "server predates the Status payload (reply carries no status "
+            "field) — upgrade the server, or you are polling a non-Status "
+            "verb"
+        )
+    if not status:
+        raise StatusUnavailable(
+            "server knows the Status verb but replied with an EMPTY "
+            "payload — unexpected server state, not version skew"
+        )
+    return status
+
+
 def fetch_status(address: str, worker: bool = False, timeout: float = 10.0) -> dict:
-    """One Status round-trip against a broker (default) or worker."""
+    """One Status round-trip against a broker (default) or worker.
+
+    Raises ``StatusUnavailable`` (with a mode-specific message, see
+    ``extract_status``) instead of returning an empty dict, so callers
+    and operators can tell "old server" from "empty reply" apart."""
     from ..rpc.client import RpcClient
     from ..rpc.protocol import Methods, Request
 
@@ -34,9 +68,7 @@ def fetch_status(address: str, worker: bool = False, timeout: float = 10.0) -> d
         )
     finally:
         client.close()
-    # defensive: an older server's Response pickle predates the status
-    # field — surface "no status" rather than AttributeError
-    return getattr(res, "status", None) or {}
+    return extract_status(res)
 
 
 def main(argv=None) -> int:
@@ -54,14 +86,21 @@ def main(argv=None) -> int:
         help="json: the full status payload; prom: Prometheus text "
              "exposition of the metrics snapshot",
     )
+    parser.add_argument(
+        "-timeout", type=float, default=10.0, metavar="SECONDS",
+        help="bound on connect AND reply wait (default 10); a wedged "
+             "server fails the poll after this instead of hanging it",
+    )
     args = parser.parse_args(argv)
     try:
-        status = fetch_status(args.address, worker=args.worker)
+        status = fetch_status(
+            args.address, worker=args.worker, timeout=args.timeout
+        )
+    except StatusUnavailable as exc:
+        print(f"no status: {exc}", file=sys.stderr)
+        return 1
     except Exception as exc:
         print(f"status fetch failed: {exc}", file=sys.stderr)
-        return 1
-    if not status:
-        print("server predates the Status verb (empty reply)", file=sys.stderr)
         return 1
     if args.format == "prom":
         from .metrics import snapshot_to_prometheus

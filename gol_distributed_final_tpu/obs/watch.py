@@ -1,0 +1,311 @@
+"""Live cluster dashboard — a ``top`` for a running GoL deployment.
+
+    python -m gol_distributed_final_tpu.obs.watch :8040
+    python -m gol_distributed_final_tpu.obs.watch 10.0.0.2:8040 \\
+        -worker 10.0.0.3:8030 -worker 10.0.0.4:8030 -interval 2
+
+Polls the broker's (and optionally each worker's) read-only ``Status``
+verb and renders a refreshing terminal panel: turn throughput, per-verb
+RPC latency, compile-cache hit rate + kernel cost analysis, per-device
+HBM occupancy, and the flight-recorder tail. Built ENTIRELY on the Status
+surface — the dashboard can be attached to and detached from a live run
+at will, costs the server one registry snapshot per poll, and never
+touches the engine or the board (unlike ``RetrieveCurrentData``).
+
+Rates (turns/s, calls/s) are derived client-side from successive counter
+snapshots, so the servers stay stateless about their observers.
+
+Every payload read goes through ``dict.get``: a server that predates a
+field renders a gap, not a crash — the skew contract of the whole obs
+surface. Pure stdlib, no jax import (pollable from any machine).
+
+``-once`` renders a single frame and exits (scripting / test hook);
+the default loop clears the screen between frames until Ctrl-C.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .status import StatusUnavailable, fetch_status
+
+_CLEAR = "\x1b[2J\x1b[H"
+
+
+# -- snapshot readers (all skew-safe: absent families render as gaps) --------
+
+
+def _series_map(snap: dict, name: str) -> Dict[tuple, dict]:
+    for fam in snap.get("families", []):
+        if fam.get("name") == name:
+            return {tuple(s.get("labels", ())): s for s in fam.get("series", [])}
+    return {}
+
+
+def _scalar(snap: dict, name: str, labels: tuple = ()) -> Optional[float]:
+    s = _series_map(snap, name).get(labels)
+    return None if s is None else s.get("value")
+
+
+def _hist_stats(series: dict) -> Tuple[int, float]:
+    """(count, mean seconds) of one histogram series."""
+    count = series.get("count") or 0
+    return count, (series.get("sum", 0.0) / count if count else 0.0)
+
+
+def _human_bytes(n: Optional[float]) -> str:
+    if n is None:
+        return "?"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024.0 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return "?"
+
+
+def _human_seconds(s: float) -> str:
+    if s < 1e-3:
+        return f"{s * 1e6:.1f}us"
+    if s < 1.0:
+        return f"{s * 1e3:.1f}ms"
+    return f"{s:.2f}s"
+
+
+# -- panel renderers ---------------------------------------------------------
+
+
+def _throughput_lines(snap: dict, rate: Optional[float]) -> List[str]:
+    turns = _scalar(snap, "gol_engine_turns_total")
+    chunks = _scalar(snap, "gol_engine_chunks_total")
+    chunk_size = _scalar(snap, "gol_engine_chunk_size")
+    step = _series_map(snap, "gol_engine_step_seconds").get(())
+    if turns is None and step is None:
+        return []
+    rate_s = f"{rate:,.0f} turns/s" if rate is not None else "rate: first poll"
+    line = (
+        f"  turns {int(turns or 0):,}   {rate_s}   "
+        f"chunks {int(chunks or 0):,}   chunk size {int(chunk_size or 0):,}"
+    )
+    out = ["THROUGHPUT", line]
+    if step:
+        count, mean = _hist_stats(step)
+        if count:
+            out.append(f"  step mean {_human_seconds(mean)}/turn over {count:,} turns")
+    return out
+
+
+def _rpc_lines(snap: dict) -> List[str]:
+    calls = _series_map(snap, "gol_rpc_server_requests_total")
+    errors = _series_map(snap, "gol_rpc_server_errors_total")
+    latency = _series_map(snap, "gol_rpc_server_request_seconds")
+    verbs = sorted(set(calls) | set(latency))
+    if not verbs:
+        return []
+    out = ["RPC (server side)          calls    errs   mean"]
+    for verb in verbs:
+        n = int((calls.get(verb) or {}).get("value") or 0)
+        e = int((errors.get(verb) or {}).get("value") or 0)
+        lat = latency.get(verb)
+        count, mean = _hist_stats(lat) if lat else (0, 0.0)
+        mean_s = _human_seconds(mean) if count else "-"
+        out.append(f"  {(verb[0] if verb else '?'):<24} {n:>6}  {e:>6}   {mean_s}")
+    return out
+
+
+def _compile_lines(snap: dict) -> List[str]:
+    requests = _series_map(snap, "gol_compile_cache_requests_total")
+    misses = _series_map(snap, "gol_compile_cache_misses_total")
+    compile_s = _series_map(snap, "gol_compile_seconds")
+    flops = _series_map(snap, "gol_kernel_flops")
+    accessed = _series_map(snap, "gol_kernel_bytes_accessed")
+    sites = sorted(set(requests) | set(compile_s) | set(flops))
+    if not sites:
+        return []
+    out = ["COMPILE + KERNELS"]
+    for site in sites:
+        label = site[0] if site else "?"
+        parts = [f"  {label:<18}"]
+        req = (requests.get(site) or {}).get("value")
+        if req:
+            miss = (misses.get(site) or {}).get("value") or 0
+            parts.append(
+                f"cache {int(req - miss)}/{int(req)} hit "
+                f"({100.0 * (req - miss) / req:.0f}%)"
+            )
+        comp = compile_s.get(site)
+        if comp:
+            count, mean = _hist_stats(comp)
+            if count:
+                parts.append(f"compiles {count} (mean {_human_seconds(mean)})")
+        fl = (flops.get(site) or {}).get("value")
+        if fl:
+            parts.append(f"{fl:.3g} flops")
+        by = (accessed.get(site) or {}).get("value")
+        if by:
+            parts.append(f"{_human_bytes(by)} accessed")
+        if len(parts) > 1:
+            out.append("  ".join(parts))
+    return out if len(out) > 1 else []
+
+
+def _hbm_lines(snap: dict) -> List[str]:
+    in_use = _series_map(snap, "gol_device_hbm_bytes_in_use")
+    peak = _series_map(snap, "gol_device_hbm_peak_bytes")
+    limit = _series_map(snap, "gol_device_hbm_bytes_limit")
+    devices = sorted(set(in_use) | set(peak))
+    out = ["HBM (per device)"]
+    if not devices:
+        out.append("  no samples (CPU backend, or engine not running here)")
+        return out
+    for dev in devices:
+        used = (in_use.get(dev) or {}).get("value")
+        cap = (limit.get(dev) or {}).get("value")
+        pk = (peak.get(dev) or {}).get("value")
+        pct = f" ({100.0 * used / cap:.0f}%)" if used and cap else ""
+        out.append(
+            f"  device {dev[0] if dev else '?'}: "
+            f"{_human_bytes(used)} / {_human_bytes(cap)}{pct}   "
+            f"peak {_human_bytes(pk)}"
+        )
+    return out
+
+
+def _flight_lines(payload: dict, tail: int = 6) -> List[str]:
+    events = payload.get("flight") or []
+    if not events:
+        return []
+    now = time.time()
+    out = [f"FLIGHT (last {min(tail, len(events))} of {len(events)} events)"]
+    for ev in events[-tail:]:
+        age = now - (ev.get("t_unix") or now)
+        out.append(
+            f"  -{age:6.1f}s  {ev.get('kind', '?'):<12} {ev.get('name', '?')}"
+        )
+    return out
+
+
+def render_status(
+    label: str,
+    payload: dict,
+    turns_rate: Optional[float] = None,
+) -> str:
+    """One target's full panel from its Status payload — pure function of
+    the payload (plus the client-side rate), so it is unit-testable
+    without a server."""
+    role = payload.get("role", "?")
+    pid = payload.get("pid", "?")
+    enabled = payload.get("metrics_enabled")
+    head = f"== {label}  ({role}, pid {pid})"
+    if not enabled:
+        head += "   [metrics DISABLED — start the server with -metrics]"
+    snap = payload.get("metrics") or {}
+    sections = [
+        _throughput_lines(snap, turns_rate),
+        _rpc_lines(snap),
+        _compile_lines(snap),
+        _hbm_lines(snap),
+        _flight_lines(payload),
+    ]
+    lines = [head]
+    for sec in sections:
+        if sec:
+            lines.append("")
+            lines.extend(sec)
+    return "\n".join(lines)
+
+
+class Watcher:
+    """Polls one broker + N workers, remembering the previous poll per
+    target so counter deltas become rates."""
+
+    def __init__(self, broker: str, workers: List[str], timeout: float):
+        self.targets = [(broker, False)] + [(w, True) for w in workers]
+        self.timeout = timeout
+        self._prev: Dict[str, Tuple[float, float]] = {}  # addr -> (t, turns)
+
+    def _turns_rate(self, addr: str, payload: dict) -> Optional[float]:
+        now = time.monotonic()
+        turns = _scalar(payload.get("metrics") or {}, "gol_engine_turns_total")
+        prev = self._prev.get(addr)
+        if turns is not None:
+            self._prev[addr] = (now, turns)
+        if prev is None or turns is None:
+            return None
+        t0, turns0 = prev
+        dt = now - t0
+        return (turns - turns0) / dt if dt > 0 else None
+
+    def frame(self) -> Tuple[str, bool]:
+        """(rendered frame, primary target ok)."""
+        stamp = time.strftime("%Y-%m-%d %H:%M:%S")
+        blocks = [f"gol watch — {stamp}   (read-only Status polls)"]
+        primary_ok = False
+        for i, (addr, is_worker) in enumerate(self.targets):
+            kind = "worker" if is_worker else "broker"
+            try:
+                payload = fetch_status(
+                    addr, worker=is_worker, timeout=self.timeout
+                )
+            except StatusUnavailable as exc:
+                blocks.append(f"== {kind} {addr}: no status — {exc}")
+                continue
+            except Exception as exc:
+                blocks.append(f"== {kind} {addr}: poll failed — {exc}")
+                continue
+            if i == 0:
+                primary_ok = True
+            blocks.append(
+                render_status(
+                    f"{kind} {addr}", payload, self._turns_rate(addr, payload)
+                )
+            )
+        return "\n\n".join(blocks), primary_ok
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="live terminal dashboard over the read-only Status verb"
+    )
+    parser.add_argument("address", help="broker host:port (or :port)")
+    parser.add_argument(
+        "-worker", action="append", default=[], metavar="HOST:PORT",
+        help="also poll this worker's GameOfLifeOperations.Status "
+             "(repeatable)",
+    )
+    parser.add_argument(
+        "-interval", type=float, default=2.0, metavar="SECONDS",
+        help="seconds between polls (default 2)",
+    )
+    parser.add_argument(
+        "-timeout", type=float, default=5.0, metavar="SECONDS",
+        help="per-target poll timeout (default 5); a wedged server costs "
+             "one interval, never hangs the dashboard",
+    )
+    parser.add_argument(
+        "-once", action="store_true",
+        help="render a single frame and exit (scripting hook)",
+    )
+    parser.add_argument(
+        "-no-clear", dest="no_clear", action="store_true",
+        help="append frames instead of clearing the screen (logs/pipes)",
+    )
+    args = parser.parse_args(argv)
+    watcher = Watcher(args.address, args.worker, args.timeout)
+    try:
+        while True:
+            frame, ok = watcher.frame()
+            if not (args.once or args.no_clear):
+                sys.stdout.write(_CLEAR)
+            print(frame, flush=True)
+            if args.once:
+                return 0 if ok else 1
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,6 +16,7 @@ from typing import Callable, Optional
 
 import jax
 
+from ..obs import device as _device
 from ..obs import instruments as _ins
 
 
@@ -38,6 +39,10 @@ def auto_plane(rule, shape: tuple[int, int]):
     Unlike the legacy ``auto_step_n_fn`` (which pack/unpacks per call), a
     plane keeps the board bit-packed across chunk dispatches — the engine's
     hot loop does no representation changes at all."""
+    # baseline HBM reading at tier-selection time (run start): even a run
+    # that dies in its first chunk leaves the pre-run occupancy on the
+    # gauges, and the first turn-chunk sample then shows the step's delta
+    _device.sample_hbm()
     word_axis = choose_word_axis(shape)
     if word_axis is None:
         # the caller falls back to the roll stencil; counted so a Status
@@ -56,6 +61,7 @@ def auto_step_n_fn(rule, shape: tuple[int, int]) -> Optional[Callable]:
 
     Legacy per-call pack/evolve/unpack form of ``auto_plane`` — same layout
     policy, kept for callers that want a plain step function."""
+    _device.sample_hbm()  # pre-run HBM baseline, as in auto_plane
     word_axis = choose_word_axis(shape)
     if word_axis is None:
         _ins.OPS_PLANE_SELECTED_TOTAL.labels("roll_stencil").inc()

@@ -339,8 +339,16 @@ def packed_step_n_fn(word_axis: int = 0, rule=None):
     survive = rule.survive_mask if rule else CONWAY_SURVIVE_MASK
 
     def step_n(board, n):
+        from ..obs import device as _device
+
         packed = pack_device(jnp.asarray(board), word_axis)
-        out = bit_step_n(packed, int(n), word_axis, birth, survive)
+        # timed lower/compile + cost analysis on first call per shape
+        # (obs/device.py) — the legacy engine path's compile telemetry
+        out = _device.compile_and_call(
+            "bitpack.xla_step", bit_step_n,
+            packed, int(n), word_axis, birth, survive,
+            static_argnums=(1, 2, 3, 4),
+        )
         return unpack_device(out, word_axis)
 
     return step_n

@@ -74,6 +74,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..obs import device as _device
 from .bitpack import bit_step
 from .stencil import CONWAY_BIRTH_MASK, CONWAY_SURVIVE_MASK
 
@@ -327,7 +328,8 @@ def _tiled_compiled(
             0, n, lambda _, p: one_turn(*([p] * n_in)), packed
         )
 
-    return run
+    # compile wall + cost analysis attributed to this kernel site (obs/)
+    return _device.instrument_jit("pallas.tiled", run)
 
 
 def tiled_bit_step_n_fn(

@@ -23,6 +23,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from ..models import CONWAY, LifeRule
+from ..obs import device as _device
 
 # shape -> whether the whole-board VMEM kernel actually compiled+ran for it.
 # fits_vmem's working-set factor is a single-point measurement
@@ -120,7 +121,13 @@ class BitPlane:
                 _VMEM_KERNEL_OK[shape] = False  # mis-calibrated gate: fall back
         if not self.interpret and self.word_axis == 0 and can_tile(shape):
             return tiled_bit_step_n_fn(rule=self.rule, interpret=False)(state, n)
-        return bit_step_n(state, n, self.word_axis, birth, survive)
+        # compile wall + cost analysis attributed to the XLA bitboard
+        # fallback (obs/device.py); semantics identical to a direct call
+        return _device.compile_and_call(
+            "bitpack.xla_step", bit_step_n,
+            state, n, self.word_axis, birth, survive,
+            static_argnums=(1, 2, 3, 4),
+        )
 
     def decode(self, state) -> np.ndarray:
         from .bitpack import unpack_device

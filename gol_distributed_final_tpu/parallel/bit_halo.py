@@ -40,6 +40,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models import CONWAY, LifeRule
+from ..obs import device as _device
 from ..obs import instruments as _ins
 from ..obs import metrics as _metrics
 from ..obs import tracing as _tracing
@@ -299,7 +300,10 @@ def sharded_bit_step_n_fn(
             # the plain XLA local step keeps it on (ADVICE.md round 3)
             check_vma=not use_pallas,
         )
-        return jax.jit(sharded, in_shardings=sharding, out_shardings=sharding)
+        jitted = jax.jit(sharded, in_shardings=sharding, out_shardings=sharding)
+        # timed explicit lower/compile + cost analysis on first call per
+        # shape (obs/device.py) — compile wall stops hiding in dispatch
+        return _device.instrument_jit("halo.bit", jitted)
 
     def step_n(packed, n):
         # routing on the static LOCAL block shape, decided before the

@@ -1,0 +1,540 @@
+"""Device-telemetry layer tests (obs/device.py + its surfaces): compile
+wall/cost-analysis capture on CPU-lowered kernels, the memory_stats
+null-on-CPU guard, the watch dashboard (pure render + one live poll
+against a broker subprocess), the noise-aware bench_diff verdicts, the
+status CLI's timeout/empty-vs-missing split, and the device-metric lint.
+"""
+
+import json
+import types
+
+import numpy as np
+import pytest
+
+from gol_distributed_final_tpu.models import CONWAY
+from gol_distributed_final_tpu.obs import device as obs_device
+from gol_distributed_final_tpu.obs import metrics as obs_metrics
+
+from helpers import REPO_ROOT
+from test_rpc import _spawn, _wait_listening
+
+
+@pytest.fixture
+def live_metrics():
+    """Enabled, zeroed registry + zeroed HBM peaks for one test; back to
+    the no-op default (and fresh HBM discovery) after."""
+    reg = obs_metrics.registry()
+    reg.reset()
+    obs_metrics.enable()
+    obs_device.reset_hbm()
+    yield reg
+    obs_metrics.enable(False)
+    reg.reset()
+    obs_device.reset_hbm()
+
+
+def _series(snapshot: dict, name: str) -> dict:
+    for fam in snapshot["families"]:
+        if fam["name"] == name:
+            return {tuple(s["labels"]): s for s in fam["series"]}
+    return {}
+
+
+# -- compile telemetry (instrument_jit / compile_and_call) -------------------
+
+
+def test_instrument_jit_records_compile_and_cost(live_metrics):
+    """First call per signature goes through a timed lower/compile with
+    XLA cost analysis captured; the second call reuses the executable
+    (compile count stays 1) and computes the same thing."""
+    import jax
+
+    jitted = jax.jit(lambda x: x @ x + 1.0)
+    wrapped = obs_device.instrument_jit("test.site", jitted)
+    x = np.ones((32, 32), np.float32)
+    first = np.asarray(wrapped(x))
+    second = np.asarray(wrapped(x))
+    np.testing.assert_array_equal(first, np.asarray(jitted(x)))
+    np.testing.assert_array_equal(first, second)
+    snap = live_metrics.snapshot()
+    compile_series = _series(snap, "gol_compile_seconds")[("test.site",)]
+    assert compile_series["count"] == 1  # second call hit the cache
+    # a 32^3 matmul has real flops on the CPU cost model
+    assert _series(snap, "gol_kernel_flops")[("test.site",)]["value"] > 0
+    assert (
+        _series(snap, "gol_kernel_bytes_accessed")[("test.site",)]["value"] > 0
+    )
+
+
+def test_instrument_jit_disabled_is_invisible():
+    """With the registry off, the wrapper is a plain call: nothing
+    recorded, and the signature is pinned to the jit path (no surprise
+    AOT recompile if metrics come on later)."""
+    import jax
+
+    wrapped = obs_device.instrument_jit("test.off", jax.jit(lambda x: x + 1))
+    x = np.zeros((4,), np.int32)
+    np.testing.assert_array_equal(np.asarray(wrapped(x)), x + 1)
+    obs_metrics.enable()
+    try:
+        np.testing.assert_array_equal(np.asarray(wrapped(x)), x + 1)
+        snap = obs_metrics.registry().snapshot()
+        assert ("test.off",) not in _series(snap, "gol_compile_seconds")
+    finally:
+        obs_metrics.enable(False)
+        obs_metrics.registry().reset()
+
+
+def test_instrument_jit_passes_through_duck_typed_fakes():
+    """A plain callable without .lower comes back unwrapped — the halo
+    tests' fake step functions must survive the instrumented factories."""
+    fn = lambda x: x  # noqa: E731
+    assert obs_device.instrument_jit("test.fake", fn) is fn
+
+
+def test_kernel_paths_record_compile_site_and_stay_exact(live_metrics):
+    """The real compile sites: a BitPlane step on CPU (interpret mode)
+    records a pallas.vmem_bit compile, and the instrumented path's
+    evolution stays bit-exact against the independent roll stencil.
+
+    The factory cache is cleared first: an earlier suite test may have
+    pulled this exact (n, masks) program while metrics were off, which
+    pins that signature to the plain jit path (the no-surprise-recompile
+    contract) — the telemetry assertion needs a genuinely fresh compile."""
+    from gol_distributed_final_tpu.ops import pallas_stencil
+    from gol_distributed_final_tpu.ops.plane import BitPlane
+
+    pallas_stencil._bit_compiled.cache_clear()
+    rng = np.random.default_rng(7)
+    board = np.where(rng.random((64, 64)) < 0.3, 255, 0).astype(np.uint8)
+    plane = BitPlane(CONWAY, 0)
+    got = plane.decode(plane.step_n(plane.encode(board), 3))
+    want = np.asarray(CONWAY.step_n(np.asarray(board), 3))
+    np.testing.assert_array_equal(got, want)
+    snap = live_metrics.snapshot()
+    compiles = _series(snap, "gol_compile_seconds")
+    assert compiles[("pallas.vmem_bit",)]["count"] >= 1
+
+
+def test_mesh_halo_path_records_compile_site(live_metrics):
+    """The byte halo plane's compile-cache miss now also records compile
+    wall + cost analysis under the halo.byte site, and the mesh evolution
+    stays exact."""
+    import jax
+
+    from gol_distributed_final_tpu.parallel import make_mesh
+    from gol_distributed_final_tpu.parallel.halo import sharded_step_n_fn
+
+    mesh = make_mesh((2, 2), devices=jax.devices()[:4])
+    step = sharded_step_n_fn(mesh)
+    rng = np.random.default_rng(11)
+    board = np.where(rng.random((32, 32)) < 0.3, 255, 0).astype(np.uint8)
+    out = np.asarray(step(board, 4))
+    want = np.asarray(CONWAY.step_n(np.asarray(board), 4))
+    np.testing.assert_array_equal(out, want)
+    snap = live_metrics.snapshot()
+    assert _series(snap, "gol_compile_seconds")[("halo.byte",)]["count"] >= 1
+    # flops estimate for the compiled mesh program landed on the gauge
+    assert _series(snap, "gol_kernel_flops")[("halo.byte",)]["value"] >= 0
+
+
+# -- HBM sampling ------------------------------------------------------------
+
+
+def test_sample_hbm_null_on_cpu(live_metrics):
+    """CPU devices report memory_stats()=None: sampling returns empty,
+    sets no gauges, never raises — and the discovery is cached so later
+    samples are free."""
+    assert obs_device.sample_hbm() == {}
+    assert obs_device.sample_hbm() == {}  # cached unsupported path
+    assert obs_device.hbm_peak_observed() == {}
+    snap = live_metrics.snapshot()
+    assert _series(snap, "gol_device_hbm_bytes_in_use") == {}
+
+
+def test_sample_hbm_gauges_and_peak_high_water(live_metrics):
+    """With a device that HAS memory stats (faked), the three gauges are
+    set and the peak-observed high-water mark survives a later, lower
+    sample — the mid-run-spike visibility the RunReport publishes."""
+
+    class Fake:
+        def __init__(self, in_use):
+            self.id = 3
+            self._in_use = in_use
+
+        def memory_stats(self):
+            return {
+                "bytes_in_use": self._in_use,
+                "peak_bytes_in_use": self._in_use,
+                "bytes_limit": 1000,
+            }
+
+    assert obs_device.sample_hbm([Fake(800)])["3"]["bytes_in_use"] == 800
+    obs_device.sample_hbm([Fake(100)])  # spike subsided
+    snap = live_metrics.snapshot()
+    assert _series(snap, "gol_device_hbm_bytes_in_use")[("3",)]["value"] == 100
+    assert _series(snap, "gol_device_hbm_bytes_limit")[("3",)]["value"] == 1000
+    assert obs_device.hbm_peak_observed() == {"3": 800}
+    # a fake-device sample must not poison the real-backend discovery
+    assert obs_device.sample_hbm() == {}
+
+
+def test_sample_hbm_supported_latch_survives_transient_failure(live_metrics):
+    """Once a backend has produced memory stats, one sweep where every
+    device fails must not permanently disable sampling (the gauges would
+    freeze mid-run) — the latch only goes False on the FIRST probe."""
+    obs_device._HBM_SUPPORTED = True  # as if a TPU sweep had succeeded
+    assert obs_device.sample_hbm() == {}  # CPU: transient-empty shape
+    assert obs_device._HBM_SUPPORTED is True  # not flipped off
+
+
+def test_engine_run_samples_hbm_without_breaking(live_metrics):
+    """A metrics-on engine run drives the per-chunk sampling path on CPU
+    (guarded null) and the run itself stays exact."""
+    from gol_distributed_final_tpu.engine.engine import Engine
+    from gol_distributed_final_tpu.params import Params
+
+    rng = np.random.default_rng(3)
+    board = np.where(rng.random((32, 32)) < 0.3, 255, 0).astype(np.uint8)
+    p = Params(turns=8, image_width=32, image_height=32)
+    result = Engine().run(p, board)
+    assert result.turns_completed == 8
+    want = np.asarray(CONWAY.step_n(np.asarray(board), 8))
+    np.testing.assert_array_equal(result.world, want)
+    assert obs_device.hbm_peak_observed() == {}  # CPU: sampled, null
+
+
+def test_device_inventory_carries_observed_peak(live_metrics):
+    """The RunReport's device inventory includes the high-water key for
+    every device (null on CPU where nothing was ever sampled)."""
+    from gol_distributed_final_tpu.obs.report import device_inventory
+
+    inventory = device_inventory()
+    for dev in inventory["local_devices"]:
+        assert "hbm_peak_observed_bytes" in dev
+        assert dev["hbm_peak_observed_bytes"] is None  # CPU backend
+
+
+# -- status CLI: -timeout + empty-vs-missing ---------------------------------
+
+
+def test_extract_status_distinguishes_old_from_empty():
+    from gol_distributed_final_tpu.obs.status import (
+        StatusUnavailable,
+        extract_status,
+    )
+
+    with pytest.raises(StatusUnavailable, match="predates"):
+        extract_status(types.SimpleNamespace())  # no field at all
+    with pytest.raises(StatusUnavailable, match="predates"):
+        extract_status(types.SimpleNamespace(status=None))
+    with pytest.raises(StatusUnavailable, match="EMPTY"):
+        extract_status(types.SimpleNamespace(status={}))
+    assert extract_status(types.SimpleNamespace(status={"pid": 1})) == {
+        "pid": 1
+    }
+
+
+def test_status_cli_timeout_flag_bounds_dead_server(capsys):
+    """-timeout reaches the client: a dead port fails fast with rc 1."""
+    from gol_distributed_final_tpu.obs.status import main as status_main
+
+    assert status_main(["-timeout", "0.5", "127.0.0.1:1"]) == 1
+    assert "status fetch failed" in capsys.readouterr().err
+
+
+# -- watch dashboard ---------------------------------------------------------
+
+
+def _synthetic_status_payload() -> dict:
+    reg = obs_metrics.Registry()
+    reg.counter("gol_engine_turns_total").inc(1000)
+    reg.gauge("gol_engine_chunk_size").set(64)
+    reg.histogram(
+        "gol_rpc_server_request_seconds", labelnames=("method",)
+    ).labels("Operations.Run").observe(0.25)
+    reg.counter(
+        "gol_rpc_server_requests_total", labelnames=("method",)
+    ).labels("Operations.Run").inc()
+    reg.counter(
+        "gol_compile_cache_requests_total", labelnames=("site",)
+    ).labels("halo.bit").inc(4)
+    reg.counter(
+        "gol_compile_cache_misses_total", labelnames=("site",)
+    ).labels("halo.bit").inc(1)
+    reg.gauge("gol_device_hbm_bytes_in_use", labelnames=("device",)).labels(
+        "0"
+    ).set(2 * 1024**3)
+    reg.gauge("gol_device_hbm_bytes_limit", labelnames=("device",)).labels(
+        "0"
+    ).set(16 * 1024**3)
+    return {
+        "role": "broker",
+        "pid": 42,
+        "metrics_enabled": True,
+        "metrics": reg.snapshot(),
+        "flight": [{"kind": "rpc.dispatch", "name": "Operations.Run"}],
+    }
+
+
+def test_watch_render_is_pure_and_skew_safe():
+    """render_status is a pure function of the payload: all panels from a
+    synthetic snapshot, and an empty payload (maximal skew) still renders
+    a header instead of crashing."""
+    from gol_distributed_final_tpu.obs.watch import render_status
+
+    frame = render_status("broker :8040", _synthetic_status_payload(), 123.4)
+    assert "THROUGHPUT" in frame and "1,000" in frame and "123 turns/s" in frame
+    assert "Operations.Run" in frame and "250.0ms" in frame
+    assert "cache 3/4 hit (75%)" in frame
+    assert "2.0GiB / 16.0GiB (12%)" in frame
+    assert "FLIGHT" in frame and "rpc.dispatch" in frame
+    bare = render_status("worker :1", {}, None)
+    assert "worker :1" in bare  # skew-safe: renders, just sparse
+
+
+def test_watch_one_poll_against_live_broker(capsys):
+    """The acceptance shape: one -once poll against a live -metrics broker
+    renders throughput and per-verb latency from the Status verb."""
+    broker = _spawn(
+        "gol_distributed_final_tpu.rpc.broker", "-port", "0", "-metrics"
+    )
+    try:
+        port = _wait_listening(broker)
+        from gol_distributed_final_tpu.io.pgm import read_board
+        from gol_distributed_final_tpu.params import Params
+        from gol_distributed_final_tpu.rpc.client import RemoteBroker
+
+        p = Params(turns=20, threads=8, image_width=64, image_height=64)
+        board = read_board(p, REPO_ROOT / "images")
+        remote = RemoteBroker(f"127.0.0.1:{port}")
+        try:
+            assert remote.run(p, board).turns_completed == 20
+        finally:
+            remote.close()
+
+        from gol_distributed_final_tpu.obs.watch import main as watch_main
+
+        assert watch_main([f"127.0.0.1:{port}", "-once"]) == 0
+        frame = capsys.readouterr().out
+        assert "THROUGHPUT" in frame
+        assert "turns 20" in frame
+        assert "Operations.Run" in frame
+        assert "HBM" in frame  # section renders (null on CPU)
+    finally:
+        if broker.poll() is None:
+            broker.kill()
+        broker.wait()
+
+
+def test_watch_one_poll_dead_target_fails_cleanly(capsys):
+    from gol_distributed_final_tpu.obs.watch import main as watch_main
+
+    assert watch_main(["127.0.0.1:1", "-once", "-timeout", "0.5"]) == 1
+    assert "poll failed" in capsys.readouterr().out
+
+
+# -- bench_diff (obs/regress.py) ---------------------------------------------
+
+
+def _bench_doc(cases: dict, provenance=None) -> dict:
+    doc = {"metric": "cell-updates/sec", "value": 1.0, "extra": cases}
+    if provenance is not None:
+        doc["provenance"] = provenance
+    return doc
+
+
+def _case(per_turn_us, spread_s=0.001, n_lo=1000, n_hi=101_000):
+    return {
+        "per_turn_us": per_turn_us,
+        "spread_s": spread_s,
+        "n_lo": n_lo,
+        "n_hi": n_hi,
+    }
+
+
+def _write(tmp_path, name, doc):
+    path = tmp_path / name
+    path.write_text(json.dumps(doc))
+    return str(path)
+
+
+def test_bench_diff_verdicts_and_exit_codes(tmp_path, capsys):
+    """Synthetic improved / regressed / noisy pairs: the regression exits
+    nonzero, the improvement and the within-noise wobble do not, and the
+    table names each verdict. Noise per side is spread_s/(n_hi-n_lo) —
+    0.01 µs/turn here, so the noisy case's +0.02 µs sits inside the
+    2x(old+new) band while the regressed case's +1 µs clears it."""
+    from gol_distributed_final_tpu.obs.regress import main as regress_main
+
+    old = _bench_doc(
+        {
+            "c_improved": _case(2.0),
+            "c_regressed": _case(1.0),
+            "c_noisy": _case(1.0, spread_s=0.001),
+            "c_removed": _case(5.0),
+        }
+    )
+    new = _bench_doc(
+        {
+            "c_improved": _case(1.0),
+            "c_regressed": _case(2.0),
+            "c_noisy": _case(1.02, spread_s=0.001),
+            "c_new": _case(3.0),
+        }
+    )
+    rc = regress_main([_write(tmp_path, "old.json", old),
+                       _write(tmp_path, "new.json", new)])
+    out = capsys.readouterr().out
+    assert rc == 1  # the regression gates
+    assert "c_improved" in out and "improved" in out
+    assert "c_regressed" in out and "REGRESSED" in out
+    assert "jitter" in out
+    assert "new" in out and "removed" in out
+
+    # a round compared against itself is all jitter: gate passes
+    rc = regress_main([_write(tmp_path, "same.json", new),
+                       _write(tmp_path, "same2.json", new)])
+    capsys.readouterr()
+    assert rc == 0
+
+
+def test_bench_diff_noise_band_suppresses_false_regression(tmp_path, capsys):
+    """A 10% slowdown whose measurements carry +-20% per-turn noise is
+    jitter, not a regression — the core noise-aware property."""
+    from gol_distributed_final_tpu.obs.regress import main as regress_main
+
+    noisy = dict(spread_s=0.01, n_lo=1000, n_hi=101_000)  # 0.1 µs/turn noise
+    old = _bench_doc({"c": _case(1.0, **noisy)})
+    new = _bench_doc({"c": _case(1.1, **noisy)})
+    rc = regress_main([_write(tmp_path, "a.json", old),
+                       _write(tmp_path, "b.json", new)])
+    assert rc == 0
+    assert "jitter" in capsys.readouterr().out
+
+
+def test_bench_diff_zero_fit_is_incomparable_either_side(tmp_path, capsys):
+    """A zero per_turn_us (broken fit on a salvaged fragment) is
+    ``incomparable`` on EITHER side — never an infinite improvement that
+    greenwashes the gate, never a phantom regression."""
+    from gol_distributed_final_tpu.obs.regress import compare_case
+    from gol_distributed_final_tpu.obs.regress import main as regress_main
+
+    assert compare_case(_case(1.0), _case(0.0))["verdict"] == "incomparable"
+    assert compare_case(_case(0.0), _case(1.0))["verdict"] == "incomparable"
+    rc = regress_main(
+        [_write(tmp_path, "za.json", _bench_doc({"c": _case(1.0)})),
+         _write(tmp_path, "zb.json", _bench_doc({"c": _case(0.0)}))]
+    )
+    assert rc == 0
+    assert "incomparable" in capsys.readouterr().out
+
+
+def test_bench_diff_refuses_cross_environment(tmp_path, capsys):
+    from gol_distributed_final_tpu.obs.regress import main as regress_main
+
+    prov_a = {"jax_version": "0.4.37", "device_kind": "TPU v5e",
+              "device_count": 1}
+    prov_b = dict(prov_a, jax_version="0.5.0")
+    old = _write(
+        tmp_path, "pa.json", _bench_doc({"c": _case(1.0)}, prov_a)
+    )
+    new = _write(
+        tmp_path, "pb.json", _bench_doc({"c": _case(1.0)}, prov_b)
+    )
+    assert regress_main([old, new]) == 2
+    assert "REFUSING" in capsys.readouterr().err
+    assert regress_main([old, new, "--force"]) == 0  # forced through
+    # identical provenance: no refusal, no warning
+    same = _write(
+        tmp_path, "pc.json", _bench_doc({"c": _case(1.0)}, prov_a)
+    )
+    capsys.readouterr()
+    assert regress_main([old, same]) == 0
+
+
+def test_bench_diff_salvages_truncated_driver_tail(tmp_path):
+    """The driver wrapper keeps only the tail of stdout: a head-truncated
+    bench line still yields every complete case object."""
+    from gol_distributed_final_tpu.obs.regress import load_bench
+
+    line = json.dumps(
+        _bench_doc({"c_lost": _case(9.0), "c_kept": _case(1.0),
+                    "c_also": _case(2.0)})
+    )
+    cut = line.index('"c_kept"') - 10  # decapitate: c_lost's body is gone
+    wrapper = {"n": 5, "cmd": "python bench.py", "rc": 0,
+               "tail": line[cut:], "parsed": None}
+    loaded = load_bench(_write(tmp_path, "BENCH_r09.json", wrapper))
+    assert loaded["salvaged"] is True
+    assert set(loaded["cases"]) == {"c_kept", "c_also"}
+
+
+def test_bench_diff_latest_mode(tmp_path, capsys):
+    """--latest picks the two newest rounds numerically (r9 < r10), and is
+    a clean no-op when fewer than two rounds exist."""
+    from gol_distributed_final_tpu.obs.regress import main as regress_main
+
+    assert regress_main(["--latest", "--dir", str(tmp_path)]) == 0
+    assert "fewer than two" in capsys.readouterr().err
+    _write(tmp_path, "BENCH_r02.json", _bench_doc({"c": _case(5.0)}))
+    _write(tmp_path, "BENCH_r09.json", _bench_doc({"c": _case(1.0)}))
+    _write(tmp_path, "BENCH_r10.json", _bench_doc({"c": _case(3.0)}))
+    rc = regress_main(["--latest", "--dir", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert "BENCH_r09.json -> BENCH_r10.json" in out
+    assert rc == 1  # 1.0 -> 3.0 is a real regression
+
+
+def test_real_bench_rounds_are_loadable():
+    """The repo's own BENCH_r*.json (driver wrappers with truncated
+    tails) load — the acceptance path scripts/bench_diff runs on."""
+    from gol_distributed_final_tpu.obs.regress import (
+        latest_bench_files,
+        load_bench,
+    )
+
+    rounds = latest_bench_files(REPO_ROOT)
+    assert len(rounds) >= 2
+    for path in rounds[-2:]:
+        assert load_bench(path)["cases"], f"{path.name}: no cases loaded"
+
+
+# -- provenance + lint -------------------------------------------------------
+
+
+def test_bench_provenance_stamp():
+    import bench
+
+    stamp = bench.provenance()
+    assert stamp["jax_version"]
+    assert stamp["device_count"] >= 1
+    assert stamp["platform"] == "cpu"
+
+
+def test_device_metrics_documented_and_sections_present():
+    from gol_distributed_final_tpu.obs.lint import (
+        missing_readme_sections,
+        undocumented_device_metrics,
+    )
+
+    assert undocumented_device_metrics() == []
+    assert missing_readme_sections() == []
+
+
+def test_device_metric_lint_is_section_scoped(tmp_path):
+    """A device metric named only AFTER the Device telemetry section's
+    end (the next ## heading) is still flagged — mention elsewhere in the
+    file does not count as documented in the table."""
+    from gol_distributed_final_tpu.obs.instruments import HBM_BYTES_IN_USE
+    from gol_distributed_final_tpu.obs.lint import undocumented_device_metrics
+
+    name = HBM_BYTES_IN_USE.name
+    readme = tmp_path / "README.md"
+    readme.write_text(
+        "### Device telemetry\n(table without the name)\n"
+        f"## Later section\n{name} mentioned here only\n"
+    )
+    assert name in undocumented_device_metrics(readme)
+    readme.write_text(f"### Device telemetry\n| `{name}` | gauge | x |\n## Next\n")
+    assert name not in undocumented_device_metrics(readme)
