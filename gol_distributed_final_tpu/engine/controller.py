@@ -33,6 +33,7 @@ from ..events import (
 )
 from ..io.pgm import read_board, write_board
 from ..models import CONWAY
+from ..obs import flight as _flight
 from ..obs import instruments as _ins
 from ..obs import metrics as _metrics
 from ..obs import tracing as _tracing
@@ -158,6 +159,7 @@ class _Ticker:
         self.done = threading.Event()
         self.paused = False
         self._last_turn = 0  # last turn seen by any successful retrieve
+        self._tick_failures = 0  # consecutive, for broker-outage log pacing
         self._thread = threading.Thread(target=self._loop, daemon=True)
 
     def start(self):
@@ -240,11 +242,26 @@ class _Ticker:
                     snap = self.broker.retrieve(include_world=False)
                 except Exception as exc:
                     # a raising tick must not kill the control thread —
-                    # keypresses (including 'q') still need servicing
-                    print(f"tick retrieve failed: {exc}")
+                    # keypresses (including 'q') still need servicing. A
+                    # broker outage means one failure every tick: log the
+                    # first and then every 10th (a reconnecting broker
+                    # handle recovers on its own — see RpcClient), and
+                    # leave each failure in the flight ring so the outage
+                    # window is reconstructable post-mortem.
+                    self._tick_failures += 1
+                    _flight.record(
+                        "controller.tick_error", type(exc).__name__,
+                        consecutive=self._tick_failures, message=str(exc)[:200],
+                    )
+                    if self._tick_failures == 1 or self._tick_failures % 10 == 0:
+                        print(
+                            f"tick retrieve failed "
+                            f"(x{self._tick_failures}): {exc}"
+                        )
                     continue
                 finally:
                     _tracing.end_span(tick_span)
+                self._tick_failures = 0
                 if t_tick:
                     _ins.CONTROLLER_TICK_SECONDS.observe(
                         time.monotonic() - t_tick

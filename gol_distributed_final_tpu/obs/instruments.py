@@ -131,6 +131,38 @@ RPC_SERVER_SENT_BYTES_TOTAL = _R.counter(
     labelnames=("method",),
 )
 
+# -- fault tolerance (rpc/client.py reconnect, rpc/broker.py recovery) ------
+
+RPC_RETRIES_TOTAL = _R.counter(
+    "gol_rpc_retries_total",
+    "RPC client transport reconnect attempts (capped jittered exponential "
+    "backoff). In-flight calls fail and are never silently re-sent; only "
+    "the transport is retried.",
+)
+WORKER_LOST_TOTAL = _R.counter(
+    "gol_worker_lost_total",
+    "Workers dropped from the broker's scatter set mid-run (connection "
+    "loss or scatter-deadline expiry) — each loss re-splits the rows over "
+    "the survivors.",
+)
+WORKER_READMITTED_TOTAL = _R.counter(
+    "gol_worker_readmitted_total",
+    "Lost or never-connected roster addresses readmitted by the broker's "
+    "background probe (a full worker Status round-trip); the row split "
+    "re-expands at the next turn.",
+)
+TURN_RETRY_TOTAL = _R.counter(
+    "gol_turn_retry_total",
+    "Scatter/gather turns recomputed after losing workers (the same turn "
+    "is retried from the committed pre-turn world — never a skipped or "
+    "half-applied turn).",
+)
+AUTO_CHECKPOINT_TOTAL = _R.counter(
+    "gol_auto_checkpoint_total",
+    "Periodic broker auto-checkpoints written (-auto-checkpoint; "
+    "tmp-then-rename, failures logged and excluded).",
+)
+
 # -- kernel-tier selection + compile cache (ops/auto.py, parallel/*) --------
 
 OPS_PLANE_SELECTED_TOTAL = _R.counter(

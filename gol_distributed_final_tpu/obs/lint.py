@@ -67,6 +67,7 @@ _REQUIRED_SECTIONS = (
     "## Tracing",
     "Device telemetry",
     "Perf regression gate",
+    "Fault tolerance",
 )
 
 

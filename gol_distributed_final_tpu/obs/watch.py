@@ -115,6 +115,39 @@ def _rpc_lines(snap: dict) -> List[str]:
     return out
 
 
+def _worker_lines(payload: dict) -> List[str]:
+    """The broker's roster health column (WorkersBackend.worker_health)
+    plus the fault-tolerance counters: who is connected, who is lost and
+    when it will next be probed, and how much recovery has happened."""
+    roster = payload.get("workers") or []
+    snap = payload.get("metrics") or {}
+    totals = [
+        (label, _scalar(snap, name))
+        for label, name in (
+            ("lost", "gol_worker_lost_total"),
+            ("readmitted", "gol_worker_readmitted_total"),
+            ("turn retries", "gol_turn_retry_total"),
+            ("auto ckpts", "gol_auto_checkpoint_total"),
+        )
+    ]
+    if not roster and not any(v for _, v in totals):
+        return []
+    out = ["WORKERS (roster health)"]
+    for w in roster:
+        state = w.get("state", "?")
+        line = f"  {w.get('address', '?'):<22} {state}"
+        retry = w.get("retry_in_s")
+        if state != "connected" and retry is not None:
+            line += f"   next probe in {retry}s"
+        out.append(line)
+    counted = "   ".join(
+        f"{label} {int(v)}" for label, v in totals if v
+    )
+    if counted:
+        out.append(f"  {counted}")
+    return out
+
+
 def _compile_lines(snap: dict) -> List[str]:
     requests = _series_map(snap, "gol_compile_cache_requests_total")
     misses = _series_map(snap, "gol_compile_cache_misses_total")
@@ -205,6 +238,7 @@ def render_status(
     sections = [
         _throughput_lines(snap, turns_rate),
         _rpc_lines(snap),
+        _worker_lines(payload),
         _compile_lines(snap),
         _hbm_lines(snap),
         _flight_lines(payload),

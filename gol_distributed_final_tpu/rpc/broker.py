@@ -23,15 +23,48 @@ controller; SuperQuit quits workers, then the broker itself
 from __future__ import annotations
 
 import argparse
+import logging
+import pathlib
+import random
 import threading
+import time
 
 import numpy as np
 
 from ..engine.engine import Engine, RunResult, Snapshot
+from ..obs import flight as _flight
+from ..obs import instruments as _ins
 from ..obs import tracing as _tracing
+from . import faults as _faults
 from .client import RpcClient, RpcError
 from .protocol import Methods, Request, Response
 from .server import RpcServer
+
+logger = logging.getLogger(__name__)
+
+# scatter-deadline policy (WorkersBackend._scatter_deadline): before any
+# turn has committed there is no latency estimate, so the first turn gets
+# the cold bound (generous: a legitimately slow first turn must not evict
+# the whole roster — pre-deadline such runs completed); after that the
+# deadline tracks the turn-time EWMA with a generous multiplier, floored
+# so scheduler hiccups don't evict healthy workers. Deliberately UNcapped
+# above the floor: a wedge then costs ~20x a legitimate turn — always
+# proportional, never an abort of a cluster whose honest turns are slow.
+# Operators wanting a tight absolute bound pin one with -rpc-deadline.
+_DEADLINE_COLD = 300.0
+_DEADLINE_FLOOR = 5.0
+# the gather additionally bounds each future by deadline + grace: the
+# client-side deadline only covers the REPLY wait, so a send stalled by a
+# peer that stopped draining its receive buffer (SIGSTOP mid-frame) would
+# otherwise hang fut.result() — and the run — forever
+_DEADLINE_GRACE = 2.0
+# per-address probe pacing: failed probes of a DEAD address back off to a
+# short cap (a restarted worker readmits within seconds), while repeat
+# LOSSES escalate to a long cap — a flapper (e.g. compute-wedged but still
+# answering Status, so every readmission costs the next turn a deadline)
+# gets quarantined exponentially instead of taxing every turn forever
+_PROBE_BACKOFF_CAP = 5.0
+_LOSS_BACKOFF_CAP = 60.0
 
 
 class TpuBackend:
@@ -176,27 +209,64 @@ class WorkersBackend:
     worker per turn, the scalability limit README.md:204 points at,
     preserved for contract archaeology)."""
 
-    def __init__(self, worker_addresses: list[str], wire: str = "haloed"):
+    def __init__(
+        self,
+        worker_addresses: list[str],
+        wire: str = "haloed",
+        *,
+        rpc_deadline: float | None = None,
+        auto_checkpoint: tuple[float, str] | None = None,
+        probe_interval: float = 1.0,
+    ):
         if wire not in ("haloed", "full"):
             raise ValueError(f"wire must be 'haloed' or 'full', got {wire!r}")
+        if probe_interval <= 0:
+            # 0 would busy-spin the probe thread and connect-storm every
+            # dead address (next-probe times of now+0 forever)
+            raise ValueError(f"probe_interval must be > 0, got {probe_interval}")
         self._wire = wire
-        self.clients: list[RpcClient] = []
-        for addr in worker_addresses:
-            try:
-                self.clients.append(RpcClient(addr, timeout=3.0))
-            except OSError:
-                # skip dead addresses, proceed with the connected subset
-                # (isConnected, broker/broker.go:39-45, 302-311)
-                print(f"worker {addr} unreachable, skipping")
-        print(f"{len(self.clients)} workers connected")
+        # None: adaptive (EWMA of observed turn time — _scatter_deadline);
+        # a float pins every scatter's reply bound (the -rpc-deadline flag)
+        self._rpc_deadline = rpc_deadline
+        self._auto_checkpoint = auto_checkpoint  # (seconds, path) or None
+        self._probe_interval = probe_interval
+        self._turn_seconds: float | None = None  # EWMA, turn-loop-local
+        self._last_ckpt = 0.0
         self._lock = threading.Lock()
         self._control = threading.Condition(self._lock)
+        # the FULL roster is kept (not just the connected subset): a dead
+        # or flapping address stays probe-able, so capacity recovers when
+        # the worker comes back instead of only ever degrading
+        self.addresses = list(worker_addresses)
+        self.clients: list[RpcClient] = []
+        self._client_addr: dict[int, str] = {}  # id(client) -> address
+        self._lost: dict[str, float] = {}  # address -> next probe (monotonic)
+        self._probe_backoff: dict[str, float] = {}
+        now = time.monotonic()
+        for addr in self.addresses:
+            try:
+                client = RpcClient(addr, timeout=3.0)
+            except OSError:
+                # dead at connect: logged and left on the roster for the
+                # probe thread, instead of the reference's skip-forever
+                # (isConnected, broker/broker.go:39-45, 302-311)
+                logger.warning("worker %s unreachable, will keep probing", addr)
+                self._lost[addr] = now + probe_interval
+                continue
+            self.clients.append(client)
+            self._client_addr[id(client)] = addr
+        logger.info(
+            "%d/%d workers connected", len(self.clients), len(self.addresses)
+        )
         self._world: np.ndarray | None = None
         self._turn = 0
         self._paused = False
         self._parked = False  # turn loop is actually waiting in the gate
         self._quit = False
         self._running = False
+        self._probe_stop = threading.Event()
+        if self.addresses:
+            threading.Thread(target=self._probe_loop, daemon=True).start()
 
     def run(self, req: Request) -> RunResult:
         if not self.clients:
@@ -266,20 +336,26 @@ class WorkersBackend:
 
     def _turn_loop(self, req: Request, h: int, initial_turn: int = 0) -> None:
         """Per-turn scatter/gather with elastic recovery: a worker that dies
-        mid-run is dropped and its rows re-split over the survivors — the
-        fault-tolerance extension the reference leaves unimplemented
-        (README.md:266-270; its gather simply hangs on worker death)."""
+        OR exceeds the per-scatter deadline mid-turn is dropped and its rows
+        re-split over the survivors (the same turn is recomputed from the
+        committed pre-turn world), and a worker readmitted by the probe
+        thread re-expands the split at the next turn — the fault-tolerance
+        extension the reference leaves unimplemented (README.md:266-270;
+        its gather simply hangs on worker death)."""
         import concurrent.futures
 
-        def scatter(client, world, s, e, trace_parent=None):
+        def scatter(client, world, s, e, deadline, trace_parent=None):
             # trace_parent: this call runs on a POOL thread where the turn
             # span's thread-local stack is invisible — the parent must ride
             # in explicitly for the per-worker Update spans to join the
             # turn (and through it the caller's whole session trace). Only
             # passed when tracing set it (like the controller's rule=
-            # kwarg): worker clients are duck-typed and plain fakes need
-            # not know the kwarg.
-            kw = {} if trace_parent is None else {"trace_parent": trace_parent}
+            # kwarg): worker clients are duck-typed and fakes only need
+            # the timeout kwarg. ``deadline`` bounds the REPLY wait: a
+            # wedged worker costs one deadline, never the whole run.
+            kw = {"timeout": deadline}
+            if trace_parent is not None:
+                kw["trace_parent"] = trace_parent
             if self._wire == "full":
                 # reference-exact: ship the whole board, worker slices
                 res = client.call(
@@ -296,15 +372,15 @@ class WorkersBackend:
                 )
             return res.work_slice
 
-        active = list(self.clients)
-
-        def plan():
+        def plan(active):
             n = max(1, min(req.threads or len(active), len(active), h))
             return n, self._split(h, n)
 
-        n, bounds = plan()
-        # one pool per run, not n fresh threads per turn
-        with concurrent.futures.ThreadPoolExecutor(len(active)) as pool:
+        # one pool per run, not n fresh threads per turn; sized to the full
+        # roster so readmitted workers get a thread without a new pool
+        pool_size = max(1, len(self.clients), len(self.addresses))
+        pool = concurrent.futures.ThreadPoolExecutor(pool_size)
+        try:
             for _ in range(req.turns - initial_turn):
                 with self._lock:
                     while self._paused and not self._quit:
@@ -321,40 +397,82 @@ class WorkersBackend:
                 # wedges when a worker stalls, so it must be on the timeline
                 turn_span = (
                     _tracing.start_span(
-                        _tracing.SPAN_BROKER_TURN, turn=self._turn, workers=n
+                        _tracing.SPAN_BROKER_TURN, turn=self._turn
                     )
                     if _tracing.enabled() else None
                 )
                 tp = turn_span.ctx() if turn_span else None
+                t_turn = time.monotonic()
+                had_loss = False
                 try:
                     while True:  # retries the SAME turn after losing workers
+                        # re-snapshot each attempt AND each turn: the probe
+                        # thread appends readmitted clients concurrently
+                        with self._lock:
+                            active = list(self.clients)
+                        if not active:
+                            raise RpcError("all workers lost mid-run")
+                        n, bounds = plan(active)
+                        deadline = self._scatter_deadline()
                         futures = [
                             pool.submit(
-                                scatter, active[i], world, *bounds[i], tp
+                                scatter, active[i], world, *bounds[i],
+                                deadline, tp,
                             )
                             for i in range(n)
                         ]
                         strips = [None] * n
                         dead = []
+                        # the gather itself is time-bounded too: the client
+                        # deadline only covers the reply wait, so a scatter
+                        # thread stuck in sendall (peer stopped draining)
+                        # must not hang fut.result() past roughly one
+                        # deadline. The send allowance scales with the
+                        # observed turn time (which includes serialisation
+                        # + send): a pinned small -rpc-deadline with big
+                        # -wire full frames must not evict healthy workers
+                        # still legitimately inside sendall. Before any
+                        # clean turn has committed there is no estimate, so
+                        # the allowance is the cold bound — a turn-1 stuck
+                        # send costs more, an honest turn-1 big send never
+                        # evicts the roster
+                        send_allowance = (
+                            10.0 * self._turn_seconds
+                            if self._turn_seconds is not None
+                            else _DEADLINE_COLD
+                        )
+                        t_gather = (
+                            time.monotonic() + deadline + _DEADLINE_GRACE
+                            + send_allowance
+                        )
                         for i, fut in enumerate(futures):
                             try:
-                                strips[i] = fut.result()
-                            except (RpcError, OSError):
+                                strips[i] = fut.result(
+                                    timeout=max(0.0, t_gather - time.monotonic())
+                                )
+                            except (
+                                RpcError,
+                                OSError,
+                                TimeoutError,
+                                concurrent.futures.TimeoutError,
+                            ):
                                 dead.append(i)
                         if not dead:
                             break
                         with self._lock:
                             if self._quit:
                                 return  # shutdown race, not a failure
-                        for i in sorted(dead, reverse=True):
-                            del active[i]
-                        if not active:
-                            raise RpcError("all workers lost mid-run")
-                        print(
-                            f"{len(dead)} worker(s) lost mid-run; "
-                            f"resplitting over {len(active)}"
+                        had_loss = True
+                        for i in dead:
+                            self._mark_lost(active[i], "scatter failed")
+                        _ins.TURN_RETRY_TOTAL.inc()
+                        with self._lock:
+                            left = len(self.clients)
+                        logger.warning(
+                            "%d worker(s) lost mid-run at turn %d; "
+                            "resplitting over %d",
+                            len(dead), self._turn, left,
                         )
-                        n, bounds = plan()
 
                     new_world = np.concatenate(strips, axis=0)
                     with self._lock:
@@ -364,6 +482,186 @@ class WorkersBackend:
                     # ends on every exit — commit, shutdown race, all-lost
                     # raise — so a wedged NEXT turn is the one left open
                     _tracing.end_span(turn_span)
+                # the adaptive-deadline signal: EWMA of CLEAN committed
+                # turns only. A loss turn's dt contains the deadline stall
+                # itself — feeding it back would let one cold wedge (300 s)
+                # seed a ~6000 s deadline for the next turn, breaking the
+                # "~20x a legitimate turn" proportionality this policy
+                # promises
+                if not had_loss:
+                    dt = time.monotonic() - t_turn
+                    self._turn_seconds = (
+                        dt if self._turn_seconds is None
+                        else 0.9 * self._turn_seconds + 0.1 * dt
+                    )
+                _faults.fault_point("broker.turn_commit")
+                self._maybe_auto_checkpoint()
+        finally:
+            # wait=False: a scatter thread stuck past its deadline (its
+            # client's close() normally frees it, but the wake is the
+            # peer's kernel's business) must not hang the run's return
+            pool.shutdown(wait=False)
+
+    # -- fault tolerance ---------------------------------------------------
+
+    def _scatter_deadline(self) -> float:
+        """Reply bound for one scatter call. ``-rpc-deadline`` pins it;
+        otherwise it adapts to the observed turn time."""
+        if self._rpc_deadline:
+            return self._rpc_deadline
+        if self._turn_seconds is None:
+            return _DEADLINE_COLD
+        return max(_DEADLINE_FLOOR, 20.0 * self._turn_seconds + 1.0)
+
+    def _mark_lost(self, client, reason: str) -> None:
+        """Drop a dead/stalled worker: CLOSE its client (a leaked corpse
+        costs every later Status poll and super_quit a timeout each),
+        remove it from the scatter set, and hand its address to the probe
+        thread for readmission."""
+        try:
+            client.close()
+        except Exception:
+            pass
+        with self._lock:
+            if client in self.clients:
+                self.clients.remove(client)
+            addr = self._client_addr.pop(id(client), None)
+            if addr is not None:
+                # escalate across REPEAT losses (the entry survives
+                # readmission): a flapper — e.g. compute-wedged but still
+                # answering the probe's Status — would otherwise be
+                # readmitted every probe interval and tax every turn a
+                # deadline; doubling to the long cap bounds that tax
+                backoff = min(
+                    _LOSS_BACKOFF_CAP,
+                    self._probe_backoff.get(addr, self._probe_interval) * 2,
+                )
+                self._probe_backoff[addr] = backoff
+                self._lost[addr] = time.monotonic() + backoff
+        _ins.WORKER_LOST_TOTAL.inc()
+        _flight.record("worker.lost", addr or "<local>", reason=reason)
+        logger.warning("worker %s lost (%s)", addr or "<local>", reason)
+
+    def _probe_loop(self) -> None:
+        """Background readmission: every lost or never-connected roster
+        address is re-dialled under per-address capped exponential backoff,
+        and must answer a full ``GameOfLifeOperations.Status`` round-trip —
+        a TCP accept is not proof of life (a wedged path accepts happily) —
+        before its fresh client joins the scatter set. The next turn's
+        plan() then re-expands the row split: capacity recovers.
+
+        Due addresses are probed serially (a deliberate simplicity trade:
+        with many unreachable-host addresses — SYN blackholes, not
+        refusals — one pass can take a few seconds per corpse, delaying a
+        recovered worker's readmission by that much)."""
+        tick = min(self._probe_interval, 0.25)
+        while not self._probe_stop.wait(tick):
+            now = time.monotonic()
+            with self._lock:
+                due = [a for a, t in self._lost.items() if t <= now]
+            for addr in due:
+                client = None
+                try:
+                    client = RpcClient(addr, timeout=2.0)
+                    try:
+                        client.call(
+                            Methods.WORKER_STATUS, Request(), timeout=2.0
+                        )
+                    except RpcError as e:
+                        # an error REPLY is a completed round-trip — the
+                        # worker is alive (e.g. a version-skewed pre-Status
+                        # worker answering "unknown method"); only
+                        # transport-level RpcErrors (timeout, closed) mean
+                        # the path is still dead
+                        if not e.is_reply:
+                            raise
+                except (OSError, RpcError):
+                    if client is not None:
+                        client.close()
+                    with self._lock:
+                        # max(prev, ...): a failed probe of a DEAD address
+                        # grows toward the short cap, but must never
+                        # COLLAPSE a loss-escalated quarantine (cap 60 s)
+                        # back down — that would un-quarantine a flapper
+                        prev = self._probe_backoff.get(
+                            addr, self._probe_interval
+                        )
+                        backoff = max(prev, min(_PROBE_BACKOFF_CAP, prev * 2))
+                        self._probe_backoff[addr] = backoff
+                        self._lost[addr] = (
+                            time.monotonic()
+                            + backoff * random.uniform(0.5, 1.5)
+                        )
+                    continue
+                with self._lock:
+                    if self._probe_stop.is_set():
+                        client.close()
+                        return
+                    self._lost.pop(addr, None)
+                    # the backoff entry is KEPT: if this readmission flaps
+                    # straight back to lost, the next quarantine doubles
+                    # from here instead of resetting to the probe interval
+                    self.clients.append(client)
+                    self._client_addr[id(client)] = addr
+                    connected = len(self.clients)
+                _ins.WORKER_READMITTED_TOTAL.inc()
+                _flight.record("worker.readmit", addr)
+                logger.info(
+                    "worker %s readmitted; %d connected", addr, connected
+                )
+
+    def _maybe_auto_checkpoint(self) -> None:
+        """Time-based crash-recovery snapshot of (world, turn, rule) in the
+        engine/checkpoint.py byte-npz format, written tmp-then-rename so a
+        crash mid-write leaves the previous checkpoint intact. Failures are
+        logged, never fatal (the engine's checkpoint posture): a full disk
+        must not abort the run this snapshot exists to protect."""
+        if not self._auto_checkpoint:
+            return
+        secs, path = self._auto_checkpoint
+        now = time.monotonic()
+        if now - self._last_ckpt < secs:
+            return
+        self._last_ckpt = now  # interval pacing even across failures
+        with self._lock:
+            world, turn = self._world, self._turn
+        from ..engine.checkpoint import npz_path, save_checkpoint
+        from ..models import CONWAY
+
+        try:
+            p = pathlib.Path(path)
+            tmp = p.with_name(p.name + ".tmp")
+            # CONWAY unconditionally: run() refused any other rule at entry
+            written = save_checkpoint(tmp, world, turn, CONWAY)
+            written.replace(npz_path(p))
+        except Exception as exc:
+            logger.error("auto-checkpoint at turn %d failed: %s", turn, exc)
+            return
+        _ins.AUTO_CHECKPOINT_TOTAL.inc()
+        _flight.record("ckpt.auto", str(p), turn=turn)
+
+    def worker_health(self) -> list[dict]:
+        """Per-address roster health for the Status payload (rendered as
+        the watch dashboard's WORKERS column): connected clients first,
+        then lost/never-connected addresses with their next probe ETA."""
+        now = time.monotonic()
+        with self._lock:
+            health = [
+                {
+                    "address": self._client_addr.get(id(c), "<local>"),
+                    "state": "connected",
+                }
+                for c in self.clients
+            ]
+            health += [
+                {
+                    "address": a,
+                    "state": "lost",
+                    "retry_in_s": round(max(0.0, t - now), 2),
+                }
+                for a, t in sorted(self._lost.items())
+            ]
+        return health
 
     def pause(self):
         """Toggle pause. On pause, blocks until the turn loop has actually
@@ -392,16 +690,43 @@ class WorkersBackend:
             self._control.notify_all()
 
     def super_quit(self):
+        # stop readmitting first: a worker that reappears during shutdown
+        # must not be re-added behind the quit fan-out's back
+        self._probe_stop.set()
         self.quit()
         # let the run loop (and its in-flight scatter) finish before taking
         # the workers down (broker/broker.go:241-249 quits loop, then workers)
         with self._lock:
             self._control.wait_for(lambda: not self._running, timeout=30)
-        for client in self.clients:
+            clients = list(self.clients)
+        for client in clients:
             try:
-                client.call(Methods.WORKER_QUIT, Request())
-            except RpcError:
+                client.call(Methods.WORKER_QUIT, Request(), timeout=5.0)
+            except (RpcError, OSError):
+                # OSError too: a half-dead socket raising here used to
+                # abort the loop and leave the REMAINING workers running
                 pass
+            try:
+                client.close()
+            except Exception:
+                pass
+        # lost-but-ALIVE workers (deadline-evicted, quarantined, not yet
+        # readmitted) must come down too — SuperQuit takes the whole
+        # cluster down (broker/broker.go:241-249), not just the currently
+        # connected subset. Best-effort dial per roster address.
+        with self._lock:
+            lost = sorted(self._lost)
+        for addr in lost:
+            try:
+                client = RpcClient(addr, timeout=2.0)
+            except OSError:
+                continue  # genuinely dead: nothing to quit
+            try:
+                client.call(Methods.WORKER_QUIT, Request(), timeout=2.0)
+            except (RpcError, OSError):
+                pass
+            finally:
+                client.close()
 
     def retrieve(self, include_world: bool) -> Snapshot:
         with self._lock:
@@ -419,9 +744,13 @@ class WorkersBackend:
         the controller's trace export gets a track per worker. Strictly
         best-effort with a short reply bound: a dead or wedged worker must
         cost 2 s, not hang the Status poll (the verb exists to debug
-        exactly such runs); pre-Status workers reply without the field."""
+        exactly such runs); pre-Status workers reply without the field.
+        Dead clients are CLOSED and dropped at loss time (_mark_lost), so
+        this no longer pays a 2 s timeout per corpse."""
         spans: list = []
-        for client in self.clients:
+        with self._lock:
+            clients = list(self.clients)
+        for client in clients:
             try:
                 res = client.call(Methods.WORKER_STATUS, Request(), timeout=2.0)
             except (RpcError, OSError):
@@ -442,15 +771,65 @@ def _require_request(req) -> Request:
 
 
 class BrokerService:
-    """Maps the wire verbs onto a backend; owns process shutdown."""
+    """Maps the wire verbs onto a backend; owns process shutdown.
 
-    def __init__(self, server: RpcServer, backend):
+    ``resume`` is the crash-recovery stash (the -resume flag): a
+    ``(world, turn, rule)`` checkpoint loaded at broker start. The FIRST
+    fresh Run (initial_turn 0) whose geometry matches is rewritten to
+    continue from the stashed turn through the already-wired initial_turn
+    machinery, then the stash is consumed — later detach/reattach Runs
+    start fresh, preserving the reference's reset-on-Run semantics."""
+
+    def __init__(self, server: RpcServer, backend, resume=None):
         self._server = server
         self.backend = backend
+        self._resume = resume  # (world, turn, rule) | None
         self.quit_event = threading.Event()
+
+    def _apply_resume(self, req: Request) -> None:
+        """Rewrite a fresh Run to continue from the -resume checkpoint.
+        Mismatches are LOUD errors: an operator who restarted with -resume
+        must not silently get a from-zero run (or a mislabelled board)."""
+        world, turn, rule = self._resume
+        if req.world is None or req.world.shape != world.shape:
+            raise ValueError(
+                f"-resume checkpoint board is "
+                f"{world.shape[1]}x{world.shape[0]} but the Run asks "
+                f"{req.image_width}x{req.image_height}"
+            )
+        if req.turns <= turn:
+            raise ValueError(
+                f"-resume checkpoint is at turn {turn}, not before "
+                f"turns={req.turns}: nothing would run"
+            )
+        requested = getattr(req, "rulestring", "")
+        if requested:
+            from ..models import LifeRule
+
+            # canonicalise before comparing (the WorkersBackend.run
+            # posture: "b3/s23" IS the Conway it spells); a genuinely
+            # different rule is still refused loudly
+            requested = LifeRule.from_rulestring(requested).rulestring
+            if requested != rule.rulestring:
+                raise ValueError(
+                    f"-resume checkpoint rule {rule.rulestring} conflicts "
+                    f"with the Run's {requested}"
+                )
+        req.world = world
+        req.initial_turn = turn
+        from ..models import CONWAY
+
+        if rule.rulestring != CONWAY.rulestring:
+            req.rulestring = rule.rulestring
+        logger.info("Run reattached to -resume checkpoint at turn %d", turn)
+        _flight.record("ckpt.resume", "broker", turn=turn)
 
     def run(self, req: Request) -> Response:
         req = _require_request(req)
+        resumed = False
+        if self._resume is not None and not getattr(req, "initial_turn", 0):
+            self._apply_resume(req)
+            resumed = True
         # server-side resume validation: the client's checkpoint loader
         # validates too, but this surface is reachable by any client.
         # getattr: initial_turn is an extension field — absent on a
@@ -469,6 +848,14 @@ class BrokerService:
                 f"{req.image_width}x{req.image_height}"
             )
         result = self.backend.run(req)
+        if resumed and result.turns_completed > getattr(req, "initial_turn", 0):
+            # consumed only once the run actually PROGRESSED past the
+            # checkpoint: a Run that fails after substitution (workers
+            # still restarting) or is consumed by a buffered pre-run Quit
+            # (the pending-control semantics both backends share) must not
+            # burn the checkpoint — the retried Run would silently start
+            # from turn 0 otherwise
+            self._resume = None
         if result.world is None:
             raise ValueError(
                 "the RPC Run contract ships the world; a final_world=False "
@@ -521,6 +908,12 @@ class BrokerService:
         payload = status_payload(
             role="broker", backend=type(self.backend).__name__
         )
+        health = getattr(self.backend, "worker_health", None)
+        if callable(health):
+            try:
+                payload["workers"] = health()
+            except Exception as exc:  # health must never break Status
+                payload["worker_health_error"] = str(exc)
         collect = getattr(self.backend, "collect_remote_spans", None)
         if callable(collect) and _tracing.enabled():
             try:
@@ -557,14 +950,24 @@ def serve(
     host: str = "127.0.0.1",
     wire: str = "haloed",
     halo_depth: int = 1,
+    rpc_deadline: float | None = None,
+    auto_checkpoint: tuple[float, str] | None = None,
+    resume=None,
+    probe_interval: float = 1.0,
 ) -> tuple[RpcServer, BrokerService]:
     server = RpcServer(host=host, port=port)
     impl = (
-        WorkersBackend(worker_addresses or [], wire=wire)
+        WorkersBackend(
+            worker_addresses or [],
+            wire=wire,
+            rpc_deadline=rpc_deadline,
+            auto_checkpoint=auto_checkpoint,
+            probe_interval=probe_interval,
+        )
         if backend == "workers"
         else TpuBackend(halo_depth=halo_depth)
     )
-    service = BrokerService(server, impl)
+    service = BrokerService(server, impl, resume=resume)
     server.register(Methods.BROKER_RUN, service.run)
     server.register(Methods.PAUSE, service.pause)
     server.register(Methods.QUIT, service.quit)
@@ -602,6 +1005,34 @@ def main(argv=None) -> None:
              "(wide halos — raise on DCN-crossed meshes)",
     )
     parser.add_argument(
+        "-rpc-deadline", dest="rpc_deadline", type=float, default=0.0,
+        metavar="SECS",
+        help="workers backend: reply bound for each per-turn scatter call "
+             "(0, the default: adapt to the observed turn time). A worker "
+             "exceeding it is treated as lost for that turn and its rows "
+             "re-split over the survivors instead of wedging the run",
+    )
+    parser.add_argument(
+        "-auto-checkpoint", dest="auto_checkpoint", nargs="+", default=None,
+        metavar=("SECS", "PATH"),
+        help="workers backend: snapshot (world, turn, rule) to PATH "
+             "(default out/broker_ck.npz, engine/checkpoint.py npz format) "
+             "at most every SECS seconds; restart with -resume PATH to "
+             "reattach after a crash",
+    )
+    parser.add_argument(
+        "-resume", default=None, metavar="CKPT",
+        help="reattach a crashed run: the first fresh Run continues from "
+             "this checkpoint's board and turn instead of turn 0 "
+             "(consumed once; later Runs start fresh)",
+    )
+    parser.add_argument(
+        "-probe-interval", dest="probe_interval", type=float, default=1.0,
+        metavar="SECS",
+        help="workers backend: base cadence of the background readmission "
+             "probe for lost/never-connected -workers addresses",
+    )
+    parser.add_argument(
         "-metrics", action="store_true", default=False,
         help="enable the metrics registry (obs/): per-verb RPC and engine "
              "timings, served live by the read-only Operations.Status verb",
@@ -613,6 +1044,10 @@ def main(argv=None) -> None:
              "via Request.trace_ctx and ship back in Status replies",
     )
     args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
     if args.metrics:
         from ..obs import metrics
 
@@ -627,10 +1062,53 @@ def main(argv=None) -> None:
         parser.error(f"-halo-depth must be >= 1, got {args.halo_depth}")
     if args.halo_depth > 1 and args.backend != "tpu":
         parser.error("-halo-depth is a tpu-backend knob (mesh planes)")
+    if args.rpc_deadline < 0:
+        parser.error(f"-rpc-deadline must be >= 0, got {args.rpc_deadline}")
+    if args.probe_interval <= 0:
+        parser.error(
+            f"-probe-interval must be > 0, got {args.probe_interval}"
+        )
+    if args.rpc_deadline and args.backend != "workers":
+        parser.error("-rpc-deadline is a workers-backend knob (scatter "
+                     "calls); the tpu backend has no per-turn fan-out")
+    auto_checkpoint = None
+    if args.auto_checkpoint is not None:
+        if args.backend != "workers":
+            parser.error("-auto-checkpoint is a workers-backend knob; the "
+                         "tpu backend checkpoints via the engine")
+        if len(args.auto_checkpoint) > 2:
+            parser.error("-auto-checkpoint takes SECS [PATH]")
+        try:
+            secs = float(args.auto_checkpoint[0])
+        except ValueError:
+            parser.error(
+                f"-auto-checkpoint SECS must be a number, got "
+                f"{args.auto_checkpoint[0]!r}"
+            )
+        if secs < 0:
+            parser.error(f"-auto-checkpoint SECS must be >= 0, got {secs}")
+        path = (
+            args.auto_checkpoint[1]
+            if len(args.auto_checkpoint) > 1
+            else "out/broker_ck.npz"
+        )
+        auto_checkpoint = (secs, path)
+    resume = None
+    if args.resume:
+        from ..engine.checkpoint import load_checkpoint
+
+        try:
+            resume = load_checkpoint(args.resume)
+        except Exception as exc:
+            parser.error(f"-resume {args.resume}: {exc}")
     addresses = [a for a in args.workers.split(",") if a]
     server, service = serve(
         args.port, args.backend, addresses, host=args.host, wire=args.wire,
         halo_depth=args.halo_depth,
+        rpc_deadline=args.rpc_deadline or None,
+        auto_checkpoint=auto_checkpoint,
+        resume=resume,
+        probe_interval=args.probe_interval,
     )
     print(f"broker listening on :{server.port} (backend={args.backend})", flush=True)
     service.quit_event.wait()

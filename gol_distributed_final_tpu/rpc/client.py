@@ -10,6 +10,7 @@ thread routes replies to per-call events.
 from __future__ import annotations
 
 import itertools
+import random
 import socket
 import threading
 import time
@@ -28,7 +29,14 @@ class RpcError(Exception):
     a truncated remote traceback — populated from the structured error
     reply of a current server (both None against an older peer), so a
     worker-side failure reaching the controller names the exception class
-    and site instead of an opaque string."""
+    and site instead of an opaque string.
+
+    ``is_reply`` distinguishes an error the SERVER sent (a completed
+    round-trip — the peer is alive) from a transport-level failure raised
+    client-side (timeout, closed connection, failed send/reconnect): the
+    broker's readmission probe treats the former as proof of life."""
+
+    is_reply = False
 
     def __init__(self, message, kind=None, remote_traceback=None):
         super().__init__(message)
@@ -36,30 +44,76 @@ class RpcError(Exception):
         self.remote_traceback = remote_traceback
 
 
+_RECONNECT_BACKOFF0 = 0.2  # first retry delay; doubles per failure
+
+
 class RpcClient:
-    def __init__(self, address: str, timeout: float | None = None):
+    """``reconnect=True`` makes the transport self-healing: when the
+    connection dies, the NEXT call dials again under capped jittered
+    exponential backoff (one attempt per call, gated by the backoff
+    window). Calls that were in flight when the connection died always
+    FAIL — no verb is ever silently re-sent (Run/Pause/Quit are not
+    idempotent); only the transport is retried, and the caller decides
+    what is safe to re-issue."""
+
+    def __init__(
+        self,
+        address: str,
+        timeout: float | None = None,
+        reconnect: bool = False,
+        max_backoff: float = 15.0,
+    ):
         host, port = address.rsplit(":", 1)
-        self._sock = socket.create_connection((host, int(port)), timeout=timeout)
-        self._sock.settimeout(None)
-        # send_frame writes header and payload separately; without NODELAY
-        # Nagle holds the second small write for the peer's delayed ACK
-        # (~40-200 ms per call — fatal for a per-turn scatter/gather)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._write_lock = threading.Lock()
+        self._addr = (host, int(port))
+        self._connect_timeout = timeout
+        self._reconnect = reconnect
+        self._max_backoff = max_backoff
+        self._backoff = 0.0
+        self._retry_at = 0.0  # monotonic gate for the next dial attempt
+        # guards transport swaps and the backoff state; NEVER held across
+        # a dial, so close() and other threads' calls stay prompt while a
+        # reconnect attempt waits out an unreachable peer's connect timeout
+        self._conn_lock = threading.Lock()
+        self._dialing = False
+        self._user_closed = False
         self._ids = itertools.count()
         self._pending: dict[int, dict] = {}
         self._pending_lock = threading.Lock()
-        self._closed = threading.Event()
-        self._reader = threading.Thread(target=self._read_loop, daemon=True)
-        self._reader.start()
+        # ONE write lock for the client's lifetime, not per-connection: a
+        # sender that acquired it just before a reconnect swapped the
+        # socket must still exclude senders on the new socket — two locks
+        # would let their header+payload writes interleave on one stream
+        self._write_lock = threading.Lock()
+        self._install(self._dial())
 
-    def _read_loop(self) -> None:
+    def _dial(self) -> socket.socket:
+        sock = socket.create_connection(self._addr, timeout=self._connect_timeout)
+        sock.settimeout(None)
+        # send_frame writes header and payload separately; without NODELAY
+        # Nagle holds the second small write for the peer's delayed ACK
+        # (~40-200 ms per call — fatal for a per-turn scatter/gather)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    def _install(self, sock: socket.socket) -> None:
+        """Publish a fresh transport and start its reader. The transport
+        is ONE tuple attribute — (socket, closed Event) — so a concurrent
+        call captures both atomically: a send failure can then only ever
+        tear down the connection the call actually used, never mark a
+        fresh socket dead through a torn sock/closed pair."""
+        closed = threading.Event()
+        self._transport = (sock, closed)
+        threading.Thread(
+            target=self._read_loop, args=(sock, closed), daemon=True
+        ).start()
+
+    def _read_loop(self, sock: socket.socket, closed: threading.Event) -> None:
         # broad catch: an allowlist-rejected or corrupt reply frame
         # (pickle.UnpicklingError, EOFError, ...) must fail every pending
         # call, not silently kill this thread and hang them forever
         try:
             while True:
-                msg, nbytes = recv_frame_sized(self._sock)
+                msg, nbytes = recv_frame_sized(sock)
                 with self._pending_lock:
                     slot = self._pending.pop(msg["id"], None)
                 if slot is not None:
@@ -67,11 +121,96 @@ class RpcClient:
                     slot["reply_bytes"] = nbytes
                     slot["event"].set()
         except Exception:
-            self._closed.set()
+            closed.set()
             with self._pending_lock:
+                # only the CURRENT connection's reader may drain: after a
+                # reconnect swapped in a fresh transport (draining first),
+                # a stale reader racing here must not fail new calls
+                if closed is self._transport[1]:
+                    for slot in self._pending.values():
+                        slot["event"].set()
+                    self._pending.clear()
+
+    def _maybe_reconnect(self) -> None:
+        """Called when a call finds the transport dead. Either installs a
+        fresh connection or raises RpcError; backoff between ATTEMPTS is
+        capped jittered exponential, so a dead peer is probed, not
+        hammered, and the first call after it returns gets through. The
+        dial itself runs OUTSIDE the lock (one attempt at a time via
+        ``_dialing``): an unreachable peer stalls only this caller for
+        the connect timeout, never close() or other threads' calls."""
+        if not self._reconnect or self._user_closed:
+            raise RpcError("connection closed")
+        with self._conn_lock:
+            if self._user_closed:
+                # re-check under the lock: a close() racing this attempt
+                # must win — it must never be resurrected by a reconnect
+                # that passed the unlocked check first
+                raise RpcError("connection closed")
+            old_sock, old_closed = self._transport
+            if not old_closed.is_set():
+                return  # another thread already reconnected
+            if self._dialing:
+                raise RpcError(
+                    f"connection to {self._addr[0]}:{self._addr[1]} is "
+                    "down; a reconnect attempt is already in progress"
+                )
+            now = time.monotonic()
+            if now < self._retry_at:
+                raise RpcError(
+                    f"connection to {self._addr[0]}:{self._addr[1]} is down; "
+                    f"reconnect backing off {self._retry_at - now:.1f}s"
+                )
+            self._dialing = True
+            _ins.RPC_RETRIES_TOTAL.inc()
+            _flight.record(
+                "rpc.reconnect", f"{self._addr[0]}:{self._addr[1]}"
+            )
+            try:
+                # shutdown, like close(): a sender still stuck in sendall
+                # on this dead socket holds the lifetime write lock — it
+                # must be WOKEN, or every call on the fresh transport
+                # would block on that lock forever
+                old_sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                old_sock.close()
+            except OSError:
+                pass
+            with self._pending_lock:
+                # anything still pending rode the dead connection: fail it
+                # now (it is never re-sent — the non-idempotency contract)
                 for slot in self._pending.values():
                     slot["event"].set()
                 self._pending.clear()
+        try:
+            sock = self._dial()
+        except OSError as e:
+            with self._conn_lock:
+                self._dialing = False
+                self._backoff = min(
+                    self._max_backoff, (self._backoff * 2) or _RECONNECT_BACKOFF0
+                )
+                self._retry_at = (
+                    time.monotonic() + self._backoff * random.uniform(0.5, 1.5)
+                )
+            raise RpcError(
+                f"reconnect to {self._addr[0]}:{self._addr[1]} "
+                f"failed: {e}"
+            ) from e
+        with self._conn_lock:
+            self._dialing = False
+            if self._user_closed:
+                # close() won while we dialed: discard, never resurrect
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                raise RpcError("connection closed")
+            self._install(sock)
+            self._backoff = 0.0
+            self._retry_at = 0.0
 
     def call(
         self,
@@ -132,27 +271,47 @@ class RpcClient:
                 _tracing.end_span(span, error_kind=err_kind)
 
     def _call(self, method: str, request: Request, timeout: float | None = None):
-        if self._closed.is_set():
-            raise RpcError("connection closed")
+        # capture THIS call's transport atomically (one tuple read, like
+        # _read_loop's args): a failure below must tear down the connection
+        # the call actually used, never a fresh one a concurrent reconnect
+        # swapped in meanwhile
+        sock, closed = self._transport
+        if closed.is_set():
+            self._maybe_reconnect()  # raises unless a fresh transport is up
+            sock, closed = self._transport
         call_id = next(self._ids)
         slot = {"event": threading.Event(), "reply": None}
         with self._pending_lock:
             self._pending[call_id] = slot
         # re-check after registering: if the reader died in between, it has
         # already drained _pending and our slot's event would never be set
-        if self._closed.is_set():
+        if closed.is_set():
             with self._pending_lock:
                 self._pending.pop(call_id, None)
             raise RpcError("connection closed")
         try:
             with self._write_lock:
                 sent = send_frame(
-                    self._sock,
+                    sock,
                     {"id": call_id, "method": method, "request": request},
                 )
         except OSError as e:
             with self._pending_lock:
                 self._pending.pop(call_id, None)
+            # a write-side failure means this transport is gone: mark it so
+            # the next call takes the reconnect path instead of re-failing.
+            # shutdown, like close(): it wakes the reader blocked in recv
+            # (a silently-vanished peer sends no FIN/RST), whose death
+            # drains _pending so concurrent timeout=None callers unblock
+            closed.set()
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
             raise RpcError(f"send failed: {e}") from e
         if _metrics.enabled():
             _ins.RPC_CLIENT_SENT_BYTES_TOTAL.labels(method).inc(sent)
@@ -172,17 +331,34 @@ class RpcClient:
             # exception class + truncated traceback beside the message; an
             # older server's reply simply lacks the keys (dict.get — the
             # envelope-level twin of the getattr field posture)
-            raise RpcError(
+            err = RpcError(
                 reply["error"],
                 kind=reply.get("error_kind"),
                 remote_traceback=reply.get("error_traceback"),
             )
+            err.is_reply = True  # a reply arrived: the peer is alive
+            raise err
         return reply["result"]
 
     def close(self) -> None:
-        self._closed.set()
+        # _user_closed first, then the lock: a reconnect attempt mid-dial
+        # re-checks it under the lock before installing, so either it
+        # discards its fresh socket, or it installed first and the
+        # transport read below sees exactly that socket — nothing leaks
+        self._user_closed = True
+        with self._conn_lock:
+            sock, closed = self._transport
+        closed.set()
         try:
-            self._sock.close()
+            # shutdown first: close() alone does not wake a thread blocked
+            # in sendall (a peer that stopped draining its receive buffer
+            # mid-frame) — the broker frees its stuck scatter thread by
+            # closing the lost worker's client, so the wake must be real
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            sock.close()
         except OSError:
             pass
 
@@ -191,8 +367,17 @@ class RemoteBroker:
     """The controller-side broker handle: same surface as InProcessBroker,
     served over RPC (the rpc.Dial("tcp", *server) role, gol/distributor.go:136)."""
 
-    def __init__(self, address: str = "127.0.0.1:8040", timeout: float | None = 10.0):
-        self.client = RpcClient(address, timeout=timeout)
+    def __init__(
+        self,
+        address: str = "127.0.0.1:8040",
+        timeout: float | None = 10.0,
+        reconnect: bool = True,
+    ):
+        # reconnect by default: the controller's ticker keeps polling
+        # Retrieve across a broker restart (crash + -resume) instead of
+        # dying with the first dropped connection; the blocking Run that
+        # was in flight still FAILS — it is never silently re-issued
+        self.client = RpcClient(address, timeout=timeout, reconnect=reconnect)
 
     def run(
         self,

@@ -1,0 +1,282 @@
+"""Deterministic fault injection — the harness the recovery paths are proven by.
+
+Two complementary mechanisms, both dependency-free and off unless a test
+(or an operator running a game-day) opts in:
+
+* ``ChaosProxy`` — a frame-aware TCP proxy that sits between an RpcClient
+  and an RpcServer and injects transport faults on the length-prefixed
+  frame stream (rpc/protocol.py framing): per-frame ``delay``,
+  ``wedge_after=N`` (stop forwarding after the N-th frame but hold the
+  sockets open — the stalled-but-alive worker the per-scatter deadline
+  exists for), ``drop_after=N`` (hard connection close — the SIGKILLed
+  peer), and ``corrupt_frame=N`` (flip payload bytes of exactly frame N —
+  the poisoned wire; byte positions come from the constructor ``seed``, so
+  a failing run replays). The global frame counter spans all connections
+  and both directions, so a wedge also starves NEW connections — the
+  broker's readmission probe cannot readmit a worker through a wedged
+  path. Frame ordering is deterministic for a single proxied connection;
+  across concurrent connections only the per-connection order is.
+
+* ``fault_point(name)`` — in-process fault sites compiled into the worker
+  dispatch, the RPC server, and the broker turn loop, triggered by the
+  ``GOL_FAULT_POINTS`` env var (parsed once per process) or
+  ``configure()`` in tests. Spec: comma-separated ``name:action:k[:arg]``
+  entries — ``raise`` (FaultInjected on exactly the k-th hit), ``exit``
+  (``os._exit(70)`` on the k-th hit: the crash that runs no finallys,
+  kill -9 with a deterministic trigger point), ``sleep`` (sleep ``arg``
+  seconds on every hit >= k), ``wedge`` (block forever from hit k on).
+  With the env var unset a fault point costs one global read and a dict
+  check — cheap enough to keep compiled into the hot paths.
+
+The chaos test suite (tests/test_chaos.py, ``scripts/check --chaos``)
+drives both against live broker/worker subprocesses.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import socket
+import threading
+import time
+from typing import Optional
+
+# the proxy frames with the REAL wire header: a private-but-shared import
+# beats re-declaring the struct (a protocol framing change must re-frame
+# the chaos proxy too, not silently desync it)
+from .protocol import _HEADER, _recv_exact
+
+
+class FaultInjected(RuntimeError):
+    """Raised by a ``raise``-action fault point — distinguishable from any
+    organic failure, so a chaos test knows its fault (and nothing else)
+    fired."""
+
+
+# -- in-process fault points -------------------------------------------------
+
+_ENV = "GOL_FAULT_POINTS"
+_lock = threading.Lock()
+_spec: Optional[dict] = None
+_loaded = False
+_hits: dict = {}
+
+
+def _parse(text: str) -> dict:
+    """``name:action:k[:arg]`` entries, comma-separated. Malformed entries
+    raise ValueError loudly: a chaos run with a typoed spec must not
+    silently run fault-free and "pass"."""
+    spec: dict = {}
+    for entry in filter(None, (e.strip() for e in text.split(","))):
+        parts = entry.split(":")
+        if len(parts) not in (2, 3, 4):
+            raise ValueError(f"bad fault spec entry {entry!r}")
+        name, action = parts[0], parts[1]
+        if action not in ("raise", "exit", "sleep", "wedge"):
+            raise ValueError(f"unknown fault action {action!r} in {entry!r}")
+        k = int(parts[2]) if len(parts) > 2 else 1
+        arg = float(parts[3]) if len(parts) > 3 else 0.0
+        if action == "sleep" and len(parts) < 4:
+            raise ValueError(f"sleep needs seconds: {entry!r} wants :k:secs")
+        spec[name] = (action, k, arg)
+    return spec
+
+
+def configure(text: Optional[str]) -> None:
+    """Test hook: install a spec string directly (None: forget it and
+    re-read the env var on the next hit). Resets the hit counters."""
+    global _spec, _loaded
+    with _lock:
+        _spec = _parse(text) if text else None
+        _loaded = text is not None
+        _hits.clear()
+
+
+def fault_point(name: str) -> None:
+    """A named site a fault can be injected at. No-op (one global read)
+    unless ``GOL_FAULT_POINTS`` / ``configure`` named this site."""
+    global _spec, _loaded
+    if not _loaded:
+        with _lock:
+            if not _loaded:
+                env = os.environ.get(_ENV, "")
+                _spec = _parse(env) if env else None
+                _loaded = True
+    spec = _spec
+    if not spec:
+        return
+    entry = spec.get(name)
+    if entry is None:
+        return
+    with _lock:
+        _hits[name] = n = _hits.get(name, 0) + 1
+    action, k, arg = entry
+    if action == "sleep":
+        if n >= k:
+            time.sleep(arg)
+    elif action == "wedge":
+        if n >= k:
+            threading.Event().wait()  # forever: the alive-but-silent hang
+    elif n == k:
+        if action == "raise":
+            raise FaultInjected(f"fault point {name!r} fired on hit {n}")
+        if action == "exit":
+            # no finallys, no flushes — the deterministic kill -9
+            os._exit(70)
+
+
+# -- TCP chaos proxy ---------------------------------------------------------
+
+
+class ChaosProxy:
+    """Frame-aware TCP proxy injecting deterministic transport faults.
+
+    ``target`` is the real server's ``host:port``; clients dial
+    ``proxy.address`` instead. Faults can be set at construction or
+    swapped live with ``set_fault`` (a game-day lever). ``close()`` tears
+    down the listener and every proxied connection, releasing wedged
+    pump threads."""
+
+    def __init__(
+        self,
+        target: str,
+        *,
+        seed: int = 0,
+        listen_host: str = "127.0.0.1",
+        listen_port: int = 0,
+        delay: float = 0.0,
+        wedge_after: Optional[int] = None,
+        drop_after: Optional[int] = None,
+        corrupt_frame: Optional[int] = None,
+    ):
+        host, port = target.rsplit(":", 1)
+        self._target = (host, int(port))
+        self._seed = seed
+        self._lock = threading.Lock()
+        self._frames = 0
+        self._faults = {
+            "delay": delay,
+            "wedge_after": wedge_after,
+            "drop_after": drop_after,
+            "corrupt_frame": corrupt_frame,
+        }
+        self._closed = threading.Event()
+        self._conns: list = []
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((listen_host, listen_port))
+        self._listener.listen(16)
+        self.port = self._listener.getsockname()[1]
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    @property
+    def address(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    @property
+    def frames_forwarded(self) -> int:
+        with self._lock:
+            return self._frames
+
+    def set_fault(self, **kw) -> None:
+        """Update fault knobs live (``delay`` / ``wedge_after`` /
+        ``drop_after`` / ``corrupt_frame``)."""
+        bad = set(kw) - set(self._faults)
+        if bad:
+            raise ValueError(f"unknown fault knob(s): {sorted(bad)}")
+        with self._lock:
+            self._faults.update(kw)
+
+    def _accept_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                break
+            if self._closed.is_set():
+                # a thread parked in accept() holds the closed listener
+                # alive in the kernel: a dial racing close() can still be
+                # accepted here and must be refused, not proxied
+                conn.close()
+                break
+            try:
+                upstream = socket.create_connection(self._target, timeout=5)
+            except OSError:
+                conn.close()
+                continue
+            upstream.settimeout(None)  # connect timeout must not bound reads
+            for s in (conn, upstream):
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                self._conns += [conn, upstream]
+            threading.Thread(
+                target=self._pump, args=(conn, upstream), daemon=True
+            ).start()
+            threading.Thread(
+                target=self._pump, args=(upstream, conn), daemon=True
+            ).start()
+
+    def _pump(self, src: socket.socket, dst: socket.socket) -> None:
+        try:
+            while True:
+                head = _recv_exact(src, _HEADER.size)
+                (length,) = _HEADER.unpack(head)
+                payload = _recv_exact(src, length)
+                with self._lock:
+                    idx = self._frames
+                    self._frames += 1
+                    faults = dict(self._faults)
+                if faults["delay"]:
+                    time.sleep(faults["delay"])
+                wedge = faults["wedge_after"]
+                if wedge is not None and idx >= wedge:
+                    # hold both sockets open, forward nothing: the peer
+                    # sees a connection that is up but silent
+                    self._closed.wait()
+                    return
+                drop = faults["drop_after"]
+                if drop is not None and idx >= drop:
+                    return  # finally closes both: the hard kill
+                corrupt = faults["corrupt_frame"]
+                if corrupt is not None and idx == corrupt and length:
+                    body = bytearray(payload)
+                    # byte 0 is the pickle PROTO opcode: flipping it makes
+                    # the corruption land as a deterministic
+                    # UnpicklingError, never a silently-wrong board — so
+                    # the extra seeded flips must stay OFF byte 0 (one of
+                    # them landing there would flip it back to valid)
+                    body[0] ^= 0xFF
+                    if length > 1:
+                        rng = random.Random(self._seed ^ idx)
+                        for _ in range(3):
+                            body[rng.randrange(1, length)] ^= 0xFF
+                    payload = bytes(body)
+                dst.sendall(head + payload)
+        except (OSError, ConnectionError):
+            pass
+        finally:
+            for s in (src, dst):
+                try:
+                    s.close()
+                except OSError:
+                    pass
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            # wake a blocked accept() (close alone leaves it holding the
+            # kernel socket alive — it would accept one more connection)
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for s in conns:
+            try:
+                s.close()
+            except OSError:
+                pass

@@ -17,6 +17,7 @@ from ..obs import flight as _flight
 from ..obs import instruments as _ins
 from ..obs import metrics as _metrics
 from ..obs import tracing as _tracing
+from . import faults as _faults
 from .protocol import Response, recv_frame_sized, send_frame
 
 # structured error replies carry the remote traceback's TAIL (the raise
@@ -125,6 +126,11 @@ class RpcServer:
                 reply = {"id": call_id, "error": f"unknown method: {method!r}"}
             else:
                 try:
+                    # chaos hook (rpc/faults.py): lets GOL_FAULT_POINTS turn
+                    # any verb dispatch into a deterministic failure/wedge;
+                    # a raise lands as a structured error reply like any
+                    # handler exception — defined behavior, not a hang
+                    _faults.fault_point("rpc.dispatch")
                     result = fn(request)
                     if span is not None and isinstance(result, Response):
                         # reply-side context: lets the client link its

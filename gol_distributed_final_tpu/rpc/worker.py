@@ -22,6 +22,7 @@ import threading
 
 import numpy as np
 
+from . import faults as _faults
 from .protocol import Methods, Request, Response
 from .server import RpcServer
 
@@ -67,6 +68,11 @@ class WorkerService:
         self.quit_event = threading.Event()
 
     def update(self, req: Request) -> Response:
+        # chaos hook (rpc/faults.py): GOL_FAULT_POINTS can wedge, crash, or
+        # fail this worker's compute deterministically — one dict check when
+        # unset. The broker's deadline/resplit/readmission paths are proven
+        # against exactly this site (tests/test_chaos.py).
+        _faults.fault_point("worker.update")
         world = np.asarray(req.world, np.uint8)
         if req.start_y == -1:  # haloed-strip wire mode
             return Response(work_slice=compute_strip_haloed(world), worker=req.worker)

@@ -28,6 +28,15 @@ assert force_virtual_cpu_devices(8), (
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: chaos/fault-injection tests (live subprocess clusters, "
+        "deliberate stalls) excluded from the tier-1 'not slow' gate; run "
+        "them via scripts/check --chaos",
+    )
+
+
 @pytest.fixture(scope="session")
 def repo_root() -> pathlib.Path:
     return REPO_ROOT

@@ -1,0 +1,818 @@
+"""Fault-tolerance / chaos suite (rpc/faults.py + the recovery paths).
+
+Fast deterministic units run in tier-1; the live-cluster scenarios —
+wedged worker behind the chaos proxy, SIGKILLed worker readmission,
+kill -9 broker + ``-resume`` — are marked ``slow`` and run via
+``scripts/check --chaos`` so the tier-1 gate stays fast.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gol_distributed_final_tpu import Params
+from gol_distributed_final_tpu.obs import metrics as obs_metrics
+from gol_distributed_final_tpu.rpc import faults
+from gol_distributed_final_tpu.rpc.broker import BrokerService, WorkersBackend
+from gol_distributed_final_tpu.rpc.client import RemoteBroker, RpcClient, RpcError
+from gol_distributed_final_tpu.rpc.faults import ChaosProxy, FaultInjected
+from gol_distributed_final_tpu.rpc.protocol import Methods, Request, Response
+from gol_distributed_final_tpu.rpc.server import RpcServer
+
+from helpers import REPO_ROOT, assert_equal_board, read_alive_cells
+from test_rpc import _poll_turn, _spawn, _wait_listening
+
+
+@pytest.fixture
+def clean_faults():
+    """Reset the fault-point spec before and after a test."""
+    faults.configure(None)
+    yield faults
+    faults.configure(None)
+
+
+@pytest.fixture
+def live_metrics():
+    """Enable the process-global registry for one test (counters no-op
+    while disabled), restoring the off default after."""
+    obs_metrics.enable()
+    yield obs_metrics
+    obs_metrics.enable(False)
+
+
+def _counter(name: str, snap=None) -> float:
+    """Summed value of a counter family from a registry/Status snapshot."""
+    if snap is None:
+        snap = obs_metrics.registry().snapshot()
+    for fam in snap.get("families", []):
+        if fam.get("name") == name:
+            return sum(s.get("value", 0.0) for s in fam.get("series", []))
+    return 0.0
+
+
+def _fetch_broker_counter(address: str, name: str) -> float:
+    from gol_distributed_final_tpu.obs.status import fetch_status
+
+    payload = fetch_status(address, timeout=5.0)
+    return _counter(name, payload.get("metrics") or {})
+
+
+# -- fault points (env-triggered in-process faults) ---------------------------
+
+
+def test_fault_point_spec_parsing_and_raise(clean_faults):
+    faults.configure("p:raise:2")
+    faults.fault_point("p")  # hit 1: no fire
+    with pytest.raises(FaultInjected, match="hit 2"):
+        faults.fault_point("p")
+    faults.fault_point("p")  # hit 3: a raise fires exactly once
+    with pytest.raises(ValueError, match="unknown fault action"):
+        faults.configure("p:explode:1")
+    with pytest.raises(ValueError, match="sleep needs seconds"):
+        faults.configure("p:sleep:1")
+
+
+def test_fault_point_sleep_and_unconfigured_noop(clean_faults):
+    faults.configure("slow:sleep:2:0.05")
+    t0 = time.monotonic()
+    faults.fault_point("slow")  # hit 1 < k: free
+    assert time.monotonic() - t0 < 0.04
+    t0 = time.monotonic()
+    faults.fault_point("slow")  # hit 2 >= k: sleeps every hit from now on
+    assert time.monotonic() - t0 >= 0.05
+    faults.fault_point("never.configured")  # unknown site: no-op
+
+
+def test_fault_point_reads_env_once(clean_faults, monkeypatch):
+    monkeypatch.setenv("GOL_FAULT_POINTS", "envp:raise:1")
+    faults.configure(None)  # forget: next hit re-reads the env
+    with pytest.raises(FaultInjected):
+        faults.fault_point("envp")
+
+
+# -- chaos proxy --------------------------------------------------------------
+
+
+def _echo_server():
+    server = RpcServer(port=0)
+    server.register("Echo.Echo", lambda req: req)
+    server.serve_background()
+    return server
+
+
+def test_proxy_forwards_frames_and_counts():
+    server = _echo_server()
+    proxy = ChaosProxy(f"127.0.0.1:{server.port}")
+    try:
+        client = RpcClient(proxy.address, timeout=5.0)
+        res = client.call("Echo.Echo", Request(turns=7), timeout=10.0)
+        assert res.turns == 7
+        assert proxy.frames_forwarded == 2  # request + reply
+        client.close()
+    finally:
+        proxy.close()
+        server.stop()
+
+
+def test_proxy_corrupt_frame_fails_call_then_reconnect_recovers():
+    """A corrupted frame must land as a failed call (unpickling error →
+    dropped connection), never a silently-wrong payload; a reconnecting
+    client then recovers through the same proxy."""
+    server = _echo_server()
+    proxy = ChaosProxy(f"127.0.0.1:{server.port}", seed=3)
+    try:
+        client = RpcClient(proxy.address, timeout=5.0, reconnect=True)
+        assert client.call("Echo.Echo", Request(turns=1), timeout=10.0).turns == 1
+        proxy.set_fault(corrupt_frame=proxy.frames_forwarded)
+        with pytest.raises(RpcError):
+            client.call("Echo.Echo", Request(turns=2), timeout=10.0)
+        deadline = time.monotonic() + 10
+        while True:  # retry across the reconnect backoff window
+            try:
+                res = client.call("Echo.Echo", Request(turns=3), timeout=10.0)
+                break
+            except RpcError:
+                assert time.monotonic() < deadline, "never recovered"
+                time.sleep(0.05)
+        assert res.turns == 3
+        client.close()
+    finally:
+        proxy.close()
+        server.stop()
+
+
+def test_client_reconnect_backoff_gates_and_recovers(live_metrics):
+    """Transport death → the next call reconnects; while the peer stays
+    dead, attempts are gated by capped jittered exponential backoff; when
+    a listener returns on the same port, the client heals."""
+    server = _echo_server()
+    proxy = ChaosProxy(f"127.0.0.1:{server.port}")
+    port = proxy.port
+    client = RpcClient(proxy.address, timeout=5.0, reconnect=True)
+    try:
+        assert client.call("Echo.Echo", Request(turns=1), timeout=10.0).turns == 1
+        retries0 = _counter("gol_rpc_retries_total")
+        proxy.close()  # the peer vanishes, connections die
+        with pytest.raises(RpcError):
+            client.call("Echo.Echo", Request(turns=2), timeout=5.0)
+        # dial attempt against a closed port: refused, starts the backoff
+        with pytest.raises(RpcError, match="reconnect|backing off"):
+            client.call("Echo.Echo", Request(turns=2), timeout=5.0)
+        # immediately again: gated by the backoff window, no dial attempt
+        with pytest.raises(RpcError, match="backing off"):
+            client.call("Echo.Echo", Request(turns=2), timeout=5.0)
+        proxy2 = ChaosProxy(f"127.0.0.1:{server.port}", listen_port=port)
+        try:
+            deadline = time.monotonic() + 10
+            while True:
+                try:
+                    res = client.call("Echo.Echo", Request(turns=4), timeout=10.0)
+                    break
+                except RpcError:
+                    assert time.monotonic() < deadline, "never reconnected"
+                    time.sleep(0.05)
+            assert res.turns == 4
+            assert _counter("gol_rpc_retries_total") > retries0
+        finally:
+            proxy2.close()
+    finally:
+        client.close()
+        server.stop()
+
+
+# -- WorkersBackend recovery units (fake workers, in-process) ----------------
+
+
+class _FakeWorker:
+    """Duck-typed scatter client: evolves nothing, echoes the strip."""
+
+    def __init__(self, delay=0.0):
+        self.delay = delay
+        self.closed = False
+        self.calls = 0
+
+    def call(self, method, req, timeout=None, **kw):
+        self.calls += 1
+        if self.delay:
+            time.sleep(self.delay)
+        if req.world is None:  # a control verb (WorkerQuit): no strip
+            return Response()
+        return Response(work_slice=req.world[1:-1])
+
+    def close(self):
+        self.closed = True
+
+
+class _DeadWorker(_FakeWorker):
+    def __init__(self, exc=RpcError("boom")):
+        super().__init__()
+        self.exc = exc
+
+    def call(self, method, req, timeout=None, **kw):
+        self.calls += 1
+        raise self.exc
+
+
+def _board(h=8, w=8, seed=5):
+    rng = np.random.default_rng(seed)
+    return np.where(rng.random((h, w)) < 0.4, 255, 0).astype(np.uint8)
+
+
+def test_dead_client_is_closed_and_dropped_mid_run(live_metrics):
+    """Satellite: a worker removed mid-run must have its RpcClient CLOSED
+    and dropped from WorkersBackend.clients — no corpse for Status polls,
+    collect_remote_spans, or super_quit to pay a timeout on."""
+    good, dead = _FakeWorker(), _DeadWorker()
+    backend = WorkersBackend([])
+    backend.clients = [good, dead]
+    lost0 = _counter("gol_worker_lost_total")
+    retries0 = _counter("gol_turn_retry_total")
+    board = _board()
+    res = backend.run(
+        Request(world=board, turns=5, threads=2, image_width=8, image_height=8)
+    )
+    assert res.turns_completed == 5
+    assert dead.closed and backend.clients == [good]
+    assert _counter("gol_worker_lost_total") == lost0 + 1
+    assert _counter("gol_turn_retry_total") == retries0 + 1
+    # the run recomputed every turn over the survivor: identity fake, so
+    # the board is unchanged and each turn cost exactly one good call
+    np.testing.assert_array_equal(res.world, board)
+
+
+def test_super_quit_survives_half_dead_socket():
+    """Satellite: the WorkerQuit fan-out must catch OSError too — a
+    half-dead socket used to abort the loop and leave the remaining
+    workers running."""
+    half_dead = _DeadWorker(OSError("broken pipe"))
+    survivor = _FakeWorker()
+    backend = WorkersBackend([])
+    backend.clients = [half_dead, survivor]
+    backend.super_quit()
+    assert survivor.calls == 1, "the quit fan-out never reached the survivor"
+    assert half_dead.closed and survivor.closed
+
+
+def test_probe_readmits_pre_status_worker(live_metrics):
+    """A version-skewed worker WITHOUT the Status verb still proves life:
+    its 'unknown method' ERROR REPLY is a completed round-trip, so the
+    probe readmits it instead of quarantining it forever."""
+    server = RpcServer(port=0)  # registers no verbs at all: every call errors
+    server.serve_background()
+    addr = f"127.0.0.1:{server.port}"
+    backend = WorkersBackend([addr], probe_interval=0.1)
+    try:
+        assert len(backend.clients) == 1  # connected at init
+        readmits0 = _counter("gol_worker_readmitted_total")
+        backend._mark_lost(backend.clients[0], "test")
+        assert backend.clients == []
+        deadline = time.monotonic() + 10
+        while not backend.clients:
+            assert time.monotonic() < deadline, (
+                "pre-Status worker never readmitted"
+            )
+            time.sleep(0.05)
+        with backend._lock:
+            assert addr not in backend._lost
+        assert _counter("gol_worker_readmitted_total") == readmits0 + 1
+    finally:
+        backend._probe_stop.set()
+        for c in backend.clients:
+            c.close()
+        server.stop()
+
+
+def test_super_quit_reaches_lost_but_alive_workers():
+    """SuperQuit takes the WHOLE cluster down: a worker that was evicted
+    (lost) but is alive and reachable still gets WorkerQuit, best-effort
+    via a fresh dial of its roster address."""
+    quits = []
+    server = RpcServer(port=0)
+    server.register(
+        Methods.WORKER_QUIT, lambda req: quits.append(1) or Response()
+    )
+    server.register(Methods.WORKER_STATUS, lambda req: Response(status={"x": 1}))
+    server.serve_background()
+    try:
+        backend = WorkersBackend([])
+        with backend._lock:
+            backend._lost[f"127.0.0.1:{server.port}"] = time.monotonic() + 999
+        backend.super_quit()
+        assert quits == [1], "lost-but-alive worker never got WorkerQuit"
+    finally:
+        server.stop()
+
+
+def test_adaptive_scatter_deadline_formula():
+    backend = WorkersBackend([])
+    assert backend._scatter_deadline() == 300.0  # cold: no turn observed yet
+    backend._turn_seconds = 0.01
+    assert backend._scatter_deadline() == 5.0  # floored
+    backend._turn_seconds = 1.0
+    assert backend._scatter_deadline() == 21.0  # 20x EWMA + 1
+    # deliberately uncapped: a wedge costs ~20x a LEGIT turn, so a slow
+    # cluster's honest 70 s turns are never evicted wholesale
+    backend._turn_seconds = 70.0
+    assert backend._scatter_deadline() == 1401.0
+    pinned = WorkersBackend([], rpc_deadline=2.5)
+    pinned._turn_seconds = 10.0
+    assert pinned._scatter_deadline() == 2.5  # -rpc-deadline wins
+
+
+def test_auto_checkpoint_writes_loadable_npz(tmp_path, live_metrics):
+    from gol_distributed_final_tpu.engine.checkpoint import load_checkpoint
+    from gol_distributed_final_tpu.models import CONWAY
+
+    path = tmp_path / "bk.npz"
+    backend = WorkersBackend([], auto_checkpoint=(0.0, str(path)))
+    backend.clients = [_FakeWorker()]
+    ckpts0 = _counter("gol_auto_checkpoint_total")
+    board = _board()
+    backend.run(
+        Request(world=board, turns=4, threads=1, image_width=8, image_height=8)
+    )
+    world, turn, rule = load_checkpoint(path)
+    assert turn == 4 and rule.rulestring == CONWAY.rulestring
+    np.testing.assert_array_equal(world, board)  # identity fake
+    assert _counter("gol_auto_checkpoint_total") == ckpts0 + 4
+    assert not path.with_name("bk.npz.tmp.npz").exists()  # renamed away
+
+
+def test_broker_service_resume_substitution_and_validation():
+    from gol_distributed_final_tpu.engine.engine import RunResult
+    from gol_distributed_final_tpu.models import CONWAY
+
+    seen = {}
+
+    class FakeBackend:
+        def run(self, req):
+            seen["req"] = req
+            return RunResult(req.turns, req.world)
+
+    ckpt_world = _board(16, 16, seed=9)
+    service = BrokerService(None, FakeBackend(), resume=(ckpt_world, 40, CONWAY))
+    fresh = _board(16, 16, seed=1)
+    service.run(
+        Request(world=fresh, turns=100, image_width=16, image_height=16)
+    )
+    assert seen["req"].initial_turn == 40
+    np.testing.assert_array_equal(seen["req"].world, ckpt_world)
+    # consumed: the next fresh Run starts from its own world at turn 0
+    service.run(
+        Request(world=fresh, turns=100, image_width=16, image_height=16)
+    )
+    assert seen["req"].initial_turn == 0
+    np.testing.assert_array_equal(seen["req"].world, fresh)
+    # loud mismatches, not silent from-zero runs
+    service2 = BrokerService(None, FakeBackend(), resume=(ckpt_world, 40, CONWAY))
+    with pytest.raises(ValueError, match="checkpoint board is"):
+        service2.run(
+            Request(world=_board(8, 8), turns=100, image_width=8, image_height=8)
+        )
+    with pytest.raises(ValueError, match="nothing would run"):
+        service2.run(
+            Request(world=fresh, turns=40, image_width=16, image_height=16)
+        )
+    # a Run that fails AFTER substitution must not burn the stash: the
+    # retried Run still resumes (workers may just have been restarting)
+    class FailsOnce(FakeBackend):
+        fails = 1
+
+        def run(self, req):
+            if self.fails:
+                self.fails -= 1
+                raise RpcError("no workers connected")
+            return super().run(req)
+
+    service3 = BrokerService(None, FailsOnce(), resume=(ckpt_world, 40, CONWAY))
+    with pytest.raises(RpcError, match="no workers"):
+        service3.run(
+            Request(world=fresh, turns=100, image_width=16, image_height=16)
+        )
+    service3.run(
+        Request(world=fresh, turns=100, image_width=16, image_height=16)
+    )
+    assert seen["req"].initial_turn == 40, "retried Run lost the resume stash"
+
+    # a Run consumed by a buffered pre-run Quit makes NO progress past the
+    # checkpoint: the stash must survive for the reattaching Run
+    class QuitConsumed(FakeBackend):
+        quits = 1
+
+        def run(self, req):
+            seen["req"] = req
+            done = req.turns if not self.quits else req.initial_turn
+            self.quits = 0
+            return RunResult(done, req.world)
+
+    service4 = BrokerService(
+        None, QuitConsumed(), resume=(ckpt_world, 40, CONWAY)
+    )
+    service4.run(
+        Request(world=fresh, turns=100, image_width=16, image_height=16)
+    )
+    assert service4._resume is not None, "no-progress Run burned the stash"
+    service4.run(
+        Request(world=fresh, turns=100, image_width=16, image_height=16)
+    )
+    assert seen["req"].initial_turn == 40  # re-applied, then consumed
+    assert service4._resume is None
+
+
+def test_pause_and_quit_race_worker_loss_without_deadlock():
+    """Satellite: Pause toggled while the turn loop is inside the resplit
+    retry parks on the committed turn; quit then ends the run. No
+    deadlock in any interleaving."""
+    slow = _FakeWorker(delay=0.02)
+    dying = _FakeWorker(delay=0.02)
+    backend = WorkersBackend([])
+
+    def die_at_5(method, req, timeout=None, **kw):
+        dying.calls += 1
+        if backend.retrieve(False).turns_completed >= 5:
+            raise RpcError("induced death mid-run")
+        time.sleep(0.02)
+        return Response(work_slice=req.world[1:-1])
+
+    dying.call = die_at_5
+    backend.clients = [slow, dying]
+    board = _board(16, 16)
+    req = Request(
+        world=board, turns=10**9, threads=2, image_width=16, image_height=16
+    )
+    t = threading.Thread(target=lambda: backend.run(req))
+    t.start()
+    try:
+        deadline = time.monotonic() + 20
+        while (
+            backend.retrieve(False).turns_completed < 6
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.005)
+        backend.pause()  # may land inside the loss/resplit retry
+        a = backend.retrieve(False).turns_completed
+        time.sleep(0.2)
+        b = backend.retrieve(False).turns_completed
+        assert a == b, "board advanced while parked"
+        assert dying.closed, "lost worker not closed"
+        backend.pause()  # resume over the survivor
+        deadline = time.monotonic() + 20
+        while (
+            backend.retrieve(False).turns_completed <= b
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.005)
+        assert backend.retrieve(False).turns_completed > b
+    finally:
+        backend.quit()
+        t.join(timeout=20)
+    assert not t.is_alive(), "run loop deadlocked"
+
+
+def test_stuck_scatter_send_cannot_hang_the_run():
+    """The client deadline only bounds the REPLY wait: a scatter stuck in
+    the SEND (peer stopped draining its receive buffer) must be cut by
+    the gather's own deadline+grace bound, not hang the run forever."""
+    release = threading.Event()
+    good = _FakeWorker()
+
+    class StuckInSend:
+        closed = False
+
+        def call(self, method, req, timeout=None, **kw):
+            release.wait()  # ignores the timeout — a blocked sendall
+            raise RpcError("released")
+
+        def close(self):
+            self.closed = True
+
+    stuck = StuckInSend()
+    backend = WorkersBackend([], rpc_deadline=0.5)
+    # steady state: a clean-turn estimate exists, so the gather's send
+    # allowance is 10x EWMA, not the generous first-turn cold bound
+    backend._turn_seconds = 0.01
+    backend.clients = [good, stuck]
+    board = _board()
+    t0 = time.monotonic()
+    try:
+        res = backend.run(
+            Request(
+                world=board, turns=3, threads=2, image_width=8, image_height=8
+            )
+        )
+    finally:
+        release.set()  # free the parked pool thread
+    assert res.turns_completed == 3
+    assert time.monotonic() - t0 < 10, "gather did not cut the stuck send"
+    assert stuck.closed and backend.clients == [good]
+    np.testing.assert_array_equal(res.world, board)
+
+
+def test_repeat_losses_escalate_probe_quarantine():
+    """A flapping worker (readmitted, then lost again) must see its
+    per-address probe backoff DOUBLE across losses — the entry survives
+    readmission — so a compute-wedged-but-Status-answering worker cannot
+    tax every turn a deadline forever."""
+    backend = WorkersBackend([], probe_interval=0.5)
+    for expected in (1.0, 2.0, 4.0):
+        fake = _FakeWorker()
+        with backend._lock:
+            backend.clients.append(fake)
+            backend._client_addr[id(fake)] = "10.0.0.9:8030"
+        backend._mark_lost(fake, "test")
+        assert backend._probe_backoff["10.0.0.9:8030"] == expected
+        # a successful readmission clears _lost but KEEPS the backoff
+        with backend._lock:
+            backend._lost.pop("10.0.0.9:8030", None)
+    assert backend.clients == []
+    # WorkersBackend refuses a busy-spin probe cadence outright
+    with pytest.raises(ValueError, match="probe_interval"):
+        WorkersBackend([], probe_interval=0)
+
+
+def test_failed_probe_never_collapses_loss_quarantine():
+    """A failed readmission probe of a dead address grows toward the short
+    probe cap, but must never shrink a loss-escalated quarantine: the live
+    probe thread keeps a pre-seeded 16 s quarantine at >= 16 s."""
+    addr = "127.0.0.1:9"  # discard port: connects are refused instantly
+    backend = WorkersBackend([addr], probe_interval=0.1)
+    try:
+        with backend._lock:
+            assert addr in backend._lost  # dead at connect, kept on roster
+            backend._probe_backoff[addr] = 16.0  # an escalated quarantine
+            backend._lost[addr] = time.monotonic()  # probe due now
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            with backend._lock:
+                if backend._lost[addr] > time.monotonic() + 1.0:
+                    break  # a failed probe rescheduled far out: preserved
+            time.sleep(0.05)
+        with backend._lock:
+            assert backend._probe_backoff[addr] >= 16.0, (
+                "failed probe collapsed the loss quarantine"
+            )
+            assert addr in backend._lost
+    finally:
+        backend._probe_stop.set()
+
+
+def test_watch_renders_worker_health_column():
+    from gol_distributed_final_tpu.obs.watch import render_status
+
+    payload = {
+        "role": "broker",
+        "pid": 1,
+        "metrics_enabled": True,
+        "workers": [
+            {"address": "10.0.0.3:8030", "state": "connected"},
+            {"address": "10.0.0.4:8030", "state": "lost", "retry_in_s": 1.5},
+        ],
+        "metrics": {
+            "families": [
+                {
+                    "name": "gol_worker_lost_total",
+                    "type": "counter",
+                    "labelnames": [],
+                    "series": [{"labels": [], "value": 3}],
+                },
+                {
+                    "name": "gol_worker_readmitted_total",
+                    "type": "counter",
+                    "labelnames": [],
+                    "series": [{"labels": [], "value": 2}],
+                },
+            ]
+        },
+    }
+    out = render_status("broker :8040", payload)
+    assert "WORKERS (roster health)" in out
+    assert "10.0.0.3:8030" in out and "connected" in out
+    assert "10.0.0.4:8030" in out and "next probe in 1.5s" in out
+    assert "lost 3" in out and "readmitted 2" in out
+    # a skewed payload without the field renders no panel, no crash
+    assert "WORKERS" not in render_status(
+        "b", {"role": "broker", "pid": 1, "metrics_enabled": True}
+    )
+
+
+# -- live chaos scenarios (subprocess clusters; slow-marked) ------------------
+
+
+def _read_board_64():
+    import gol_distributed_final_tpu.io.pgm as pgm
+
+    p = Params(turns=1, image_width=64, image_height=64)
+    return pgm.read_board(p, REPO_ROOT / "images")
+
+
+def _oracle_64(turns):
+    from oracle import vector_step
+
+    world = _read_board_64()
+    for _ in range(turns):
+        world = vector_step(world)
+    return world
+
+
+def _kill_all(procs):
+    for p in procs:
+        if p is not None:
+            if p.poll() is None:
+                p.kill()
+            p.wait()
+
+
+@pytest.mark.slow
+def test_wedged_worker_costs_at_most_one_deadline_golden(tmp_path):
+    """Acceptance (a): a worker wedged at the transport (chaos proxy,
+    wedge from frame 0) costs the run AT MOST one -rpc-deadline — the
+    broker drops it at the deadline, resplits, and completes with the
+    bit-correct final board instead of hanging like the reference. The
+    readmission probe must NOT readmit it: a probe through the wedged
+    path cannot complete the required Status round-trip."""
+    from test_rpc import _run_remote
+
+    workers = [
+        _spawn("gol_distributed_final_tpu.rpc.worker", "-port", "0")
+        for _ in range(2)
+    ]
+    broker = proxy = None
+    try:
+        ports = [_wait_listening(w) for w in workers]
+        proxy = ChaosProxy(f"127.0.0.1:{ports[1]}", wedge_after=0)
+        broker = _spawn(
+            "gol_distributed_final_tpu.rpc.broker",
+            "-port", "0", "-backend", "workers", "-metrics",
+            "-workers", f"127.0.0.1:{ports[0]},{proxy.address}",
+            "-rpc-deadline", "2", "-probe-interval", "0.2",
+        )
+        address = f"127.0.0.1:{_wait_listening(broker)}"
+        t0 = time.monotonic()
+        result, _ = _run_remote(address, 64, 100, tmp_path, threads=2)
+        elapsed = time.monotonic() - t0
+        expected = read_alive_cells(
+            REPO_ROOT / "check" / "images" / "64x64x100.pgm"
+        )
+        assert_equal_board(result.alive, expected, 64, 64)
+        # paid the one deadline for the wedged scatter, and only that:
+        # nowhere near a second 60 s cold deadline or a hang
+        assert 2.0 <= elapsed < 30.0, f"elapsed {elapsed:.1f}s"
+        assert _fetch_broker_counter(address, "gol_worker_lost_total") == 1
+        assert (
+            _fetch_broker_counter(address, "gol_worker_readmitted_total") == 0
+        ), "a wedged path must not be readmitted"
+    finally:
+        if proxy is not None:
+            proxy.close()
+        _kill_all([*workers, broker])
+
+
+@pytest.mark.slow
+def test_worker_killed_restarted_is_readmitted_and_split_reexpands(tmp_path):
+    """Acceptance (b) + the pause/loss race satellite, live: SIGKILL a
+    worker mid-run (Pause racing the resplit retry parks cleanly on the
+    committed turn), restart it on the same port, and the probe readmits
+    it — readmitted counter > 0, the restarted worker serves Update
+    traffic again (the split re-expanded), and the final board is
+    bit-identical to the oracle."""
+    turns = 4000
+    workers = [
+        _spawn("gol_distributed_final_tpu.rpc.worker", "-port", "0")
+        for _ in range(3)
+    ]
+    broker = restarted = None
+    try:
+        ports = [_wait_listening(w) for w in workers]
+        broker = _spawn(
+            "gol_distributed_final_tpu.rpc.broker",
+            "-port", "0", "-backend", "workers", "-metrics",
+            "-workers", ",".join(f"127.0.0.1:{p}" for p in ports),
+            "-rpc-deadline", "5", "-probe-interval", "0.2",
+        )
+        address = f"127.0.0.1:{_wait_listening(broker)}"
+        p = Params(turns=turns, threads=3, image_width=64, image_height=64)
+        board = _read_board_64()
+        remote = RemoteBroker(address, timeout=30.0)
+        result = {}
+        t = threading.Thread(target=lambda: result.update(r=remote.run(p, board)))
+        t.start()
+        try:
+            _poll_turn(remote, 300)
+            workers[1].kill()  # SIGKILL mid-run
+            workers[1].wait()
+            remote.pause()  # races the loss/resplit retry; must park
+            a = remote.retrieve(include_world=False).turns_completed
+            time.sleep(0.3)
+            b = remote.retrieve(include_world=False).turns_completed
+            assert a == b, "board advanced while parked"
+            assert a < turns, "run finished before the kill landed"
+            # restart the worker on ITS OLD PORT: the roster address heals
+            restarted = _spawn(
+                "gol_distributed_final_tpu.rpc.worker",
+                "-port", str(ports[1]), "-metrics",
+            )
+            _wait_listening(restarted)
+            deadline = time.monotonic() + 30
+            while (
+                _fetch_broker_counter(address, "gol_worker_readmitted_total")
+                < 1
+            ):
+                assert time.monotonic() < deadline, "never readmitted"
+                time.sleep(0.2)
+            remote.pause()  # resume; next turn replans over 3 workers
+            t.join(timeout=300)
+            assert not t.is_alive(), "run did not complete after readmission"
+        finally:
+            if t.is_alive():
+                remote.quit()
+                t.join(timeout=30)
+            remote.close()
+        r = result["r"]
+        assert r.turns_completed == turns
+        np.testing.assert_array_equal(r.world, _oracle_64(turns))
+        assert _fetch_broker_counter(address, "gol_worker_lost_total") >= 1
+        # the readmitted worker carried strips again: split re-expanded
+        from gol_distributed_final_tpu.obs.status import fetch_status
+
+        wpayload = fetch_status(
+            f"127.0.0.1:{ports[1]}", worker=True, timeout=5.0
+        )
+        updates = 0.0
+        for fam in (wpayload.get("metrics") or {}).get("families", []):
+            if fam["name"] == "gol_rpc_server_requests_total":
+                updates = sum(
+                    s["value"]
+                    for s in fam["series"]
+                    if Methods.WORKER_UPDATE in tuple(s["labels"])
+                )
+        assert updates > 0, "restarted worker never served Update again"
+    finally:
+        _kill_all([*workers, broker, restarted])
+
+
+@pytest.mark.slow
+def test_broker_kill9_resume_is_bit_identical(tmp_path):
+    """Acceptance (c): kill -9 the broker mid-run; restart it with
+    -resume pointing at its -auto-checkpoint; the reattached run's final
+    board is bit-identical to an uninterrupted run (the oracle)."""
+    turns = 4000
+    ckpt = tmp_path / "bk.npz"
+    workers = [
+        _spawn("gol_distributed_final_tpu.rpc.worker", "-port", "0")
+        for _ in range(2)
+    ]
+    broker = broker2 = None
+    try:
+        ports = [_wait_listening(w) for w in workers]
+        addrs = ",".join(f"127.0.0.1:{p}" for p in ports)
+        broker = _spawn(
+            "gol_distributed_final_tpu.rpc.broker",
+            "-port", "0", "-backend", "workers", "-workers", addrs,
+            "-auto-checkpoint", "0.05", str(ckpt),
+        )
+        address = f"127.0.0.1:{_wait_listening(broker)}"
+        p = Params(turns=turns, threads=2, image_width=64, image_height=64)
+        board = _read_board_64()
+        remote = RemoteBroker(address, timeout=30.0)
+        outcome = {}
+
+        def runner():
+            try:
+                outcome["r"] = remote.run(p, board)
+            except Exception as e:
+                outcome["e"] = e
+
+        t = threading.Thread(target=runner)
+        t.start()
+        _poll_turn(remote, 500)
+        deadline = time.monotonic() + 10
+        while not ckpt.exists():
+            assert time.monotonic() < deadline, "auto-checkpoint never wrote"
+            time.sleep(0.02)
+        broker.kill()  # SIGKILL: no finallys, no flushes
+        broker.wait()
+        t.join(timeout=30)
+        assert not t.is_alive()
+        remote.close()
+        assert "e" in outcome, "Run should have failed with the broker"
+
+        broker2 = _spawn(
+            "gol_distributed_final_tpu.rpc.broker",
+            "-port", "0", "-backend", "workers", "-workers", addrs,
+            "-auto-checkpoint", "0.05", str(ckpt),
+            "-resume", str(ckpt),
+        )
+        address2 = f"127.0.0.1:{_wait_listening(broker2)}"
+        remote2 = RemoteBroker(address2, timeout=30.0)
+        try:
+            # the controller re-issues the SAME fresh Run; the broker
+            # reattaches it at the checkpoint's turn via initial_turn
+            r = remote2.run(p, board)
+        finally:
+            remote2.close()
+        assert r.turns_completed == turns
+        np.testing.assert_array_equal(r.world, _oracle_64(turns))
+    finally:
+        _kill_all([*workers, broker, broker2])

@@ -674,7 +674,7 @@ def test_workers_backend_pause_parks_before_return():
     from gol_distributed_final_tpu.rpc.protocol import Response
 
     class SlowFakeWorker:
-        def call(self, method, req):
+        def call(self, method, req, timeout=None, **kw):
             time.sleep(0.05)
             return Response(work_slice=req.world[1:-1])
 
