@@ -198,6 +198,97 @@ def gated(time_fn, n_lo, n_hi, label, attempts=3):
     raise last
 
 
+def _bench_wire_modes(extra: dict) -> int:
+    """The workers-backend data plane on a loopback 4-worker cluster
+    (in-process RpcServers — real sockets, real frames): ``-wire full``
+    vs ``haloed`` vs ``resident`` at K ∈ {1, 8}. Beside the wall-clock
+    fit, each case embeds ``wire_bytes_per_turn`` measured from
+    ``gol_wire_bytes_total`` over a fixed run — so ``scripts/bench_diff``
+    gates the COMMS trajectory, not just wall-clock. The resident-vs-
+    haloed byte ratio is a hard gate here (≥ 10×): byte accounting is
+    deterministic, unlike loopback timing."""
+    import numpy as np
+
+    from gol_distributed_final_tpu.obs import metrics as obs_metrics
+    from gol_distributed_final_tpu.rpc import worker as rpc_worker
+    from gol_distributed_final_tpu.rpc.broker import WorkersBackend
+    from gol_distributed_final_tpu.rpc.protocol import Request
+
+    def wire_bytes() -> float:
+        for fam in obs_metrics.registry().snapshot()["families"]:
+            if fam["name"] == "gol_wire_bytes_total":
+                return sum(s["value"] for s in fam["series"])
+        return 0.0
+
+    size = 256
+    servers = [rpc_worker.serve(port=0) for _ in range(4)]
+    addrs = [f"127.0.0.1:{s.port}" for s, _ in servers]
+    rng = np.random.default_rng(1)
+    board = np.where(rng.random((size, size)) < 0.3, 255, 0).astype(np.uint8)
+    want100 = None  # cross-mode parity reference (100 turns)
+    try:
+        for wire, k, key, n_lo, n_hi in (
+            ("full", 1, "c7_wire_full", 30, 230),
+            ("haloed", 1, "c7_wire_haloed", 30, 230),
+            # resident turns are much cheaper per RPC: wider endpoints so
+            # the marginal work still dominates loopback timing noise
+            ("resident", 1, "c7_wire_resident_k1", 100, 1100),
+            ("resident", 8, "c7_wire_resident_k8", 100, 1100),
+        ):
+            backend = WorkersBackend(addrs, wire=wire, halo_depth=k)
+            try:
+                def evolve(n, backend=backend):
+                    return backend.run(
+                        Request(
+                            world=board, turns=n, threads=4,
+                            image_width=size, image_height=size,
+                        )
+                    )
+
+                got = np.asarray(evolve(100).world)
+                if want100 is None:
+                    want100 = got
+                elif not np.array_equal(got, want100):
+                    print(f"PARITY FAILURE wire={wire} k={k}", file=sys.stderr)
+                    return 1
+                n_bytes = 400 if wire == "resident" else 200
+                b0 = wire_bytes()
+                evolve(n_bytes)
+                per_turn_bytes = (wire_bytes() - b0) / n_bytes
+                pt, det = gated(evolve, n_lo, n_hi, key)
+                extra[key] = dict(
+                    det,
+                    cell_updates_per_s=round(size * size / pt),
+                    wire=wire,
+                    halo_depth=k,
+                    wire_bytes_per_turn=round(per_turn_bytes, 1),
+                )
+            finally:
+                backend.close()
+        print("parity wire modes ok (100 turns, cross-mode)", file=sys.stderr)
+        hal = extra["c7_wire_haloed"]["wire_bytes_per_turn"]
+        res8 = extra["c7_wire_resident_k8"]["wire_bytes_per_turn"]
+        if res8 * 10 > hal:
+            print(
+                f"WIRE GATE FAILURE: resident k8 moves {res8:.0f} B/turn vs "
+                f"haloed {hal:.0f} — less than the 10x contract",
+                file=sys.stderr,
+            )
+            return 1
+        extra["c7_wire_resident_k8"]["bytes_ratio_vs_haloed"] = round(
+            hal / res8, 1
+        )
+        print(
+            f"wire gate ok: resident k8 {res8:.0f} B/turn, haloed "
+            f"{hal:.0f} B/turn ({hal / res8:.0f}x fewer)",
+            file=sys.stderr,
+        )
+    finally:
+        for server, _service in servers:
+            server.stop()
+    return 0
+
+
 def main(argv=None) -> int:
     import argparse
     import contextlib
@@ -441,6 +532,11 @@ def _bench_body() -> int:
         # drop BOTH references (the closure's default-arg binding keeps the
         # device buffer alive otherwise) so the 512 MiB frees between sizes
         del evolve_big, state_big
+
+    # ---- config 7: the RPC data plane — wire modes, loopback 4 workers ----
+    rc = _bench_wire_modes(extra)
+    if rc:
+        return rc
 
     # the RunReport's compact breakdown (obs/report.stage_timings): every
     # nonzero histogram series as {count, sum_s, mean_s} + nonzero counters

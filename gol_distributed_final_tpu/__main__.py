@@ -109,8 +109,9 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "-halo-depth", dest="halo_depth", type=int, default=0,
-        help="with -server: wide-halo depth for the broker's mesh planes "
-             "(turns per halo exchange; 0 = the broker's default)",
+        help="with -server: turns per halo exchange on the broker — the "
+             "tpu backend's mesh planes, or a resident-wire workers "
+             "backend's batch depth K (0 = the broker's default)",
     )
     parser.add_argument(
         "-metrics", action="store_true", default=False,

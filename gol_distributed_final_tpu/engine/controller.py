@@ -341,8 +341,17 @@ def run(
     reference lacks (SURVEY.md §5 checkpoint/resume).
 
     ``halo_depth`` (0 = backend default) ships the wide-halo depth to a
-    remote broker's mesh planes — the DCN lever on the session surface
+    remote broker — the tpu backend's mesh planes, or the workers
+    backend's resident batch depth K (``-wire resident``: K turns per
+    StripStep round-trip) — the DCN lever on the session surface
     (VERDICT r4 item 5). Only meaningful with ``broker=``.
+
+    Snapshot/pause semantics hold across every remote data plane: a
+    resident-wire broker re-syncs its workers' strips before answering a
+    full-world Retrieve (the 's' snapshot path) and before parking on
+    Pause, so this controller needs no mode awareness — the ticker's
+    count-only retrieve is served from the per-step alive counts the
+    StripStep replies carry.
 
     ``report`` writes a RunReport (obs/report.py: the metrics registry +
     device inventory) to ``out_dir/report_<W>x<H>x<Turns>.json`` at

@@ -131,6 +131,28 @@ RPC_SERVER_SENT_BYTES_TOTAL = _R.counter(
     labelnames=("method",),
 )
 
+# -- wire data plane (rpc/protocol.py frames, rpc/broker.py wire modes) -----
+
+WIRE_BYTES_TOTAL = _R.counter(
+    "gol_wire_bytes_total",
+    "Frame bytes this process's RPC clients moved, by verb and direction "
+    "(sent/received) — the data-plane comms meter the wire-mode bench "
+    "cases embed and scripts/bench_diff gates.",
+    labelnames=("verb", "direction"),
+)
+TURN_BATCH_SIZE = _R.histogram(
+    "gol_turn_batch_size",
+    "Turns advanced per workers-backend RPC batch (resident wire mode: K "
+    "turns per StripStep round-trip; full/haloed: always 1).",
+    buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0),
+)
+STRIP_RESYNC_TOTAL = _R.counter(
+    "gol_strip_resync_total",
+    "Resident-mode full strip re-syncs (StripFetch gathers): -sync-interval "
+    "expiry, snapshot/pause/checkpoint/run-end boundaries, and loss "
+    "recovery.",
+)
+
 # -- fault tolerance (rpc/client.py reconnect, rpc/broker.py recovery) ------
 
 RPC_RETRIES_TOTAL = _R.counter(

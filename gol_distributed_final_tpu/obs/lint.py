@@ -68,6 +68,15 @@ _REQUIRED_SECTIONS = (
     "Device telemetry",
     "Perf regression gate",
     "Fault tolerance",
+    "Wire modes",
+)
+
+# the wire data-plane metric families (rpc/protocol.py frames + the
+# workers-backend wire modes): these must be documented in the README's
+# "Wire modes" section specifically — they are the contract the wire-mode
+# bench cases embed and scripts/bench_diff gates
+_WIRE_METRIC_NAMES = (
+    "gol_wire_bytes_total", "gol_turn_batch_size", "gol_strip_resync_total",
 )
 
 
@@ -95,6 +104,24 @@ def undocumented_device_metrics(readme_path=None) -> List[str]:
         if fam.name.startswith(_DEVICE_METRIC_PREFIXES)
         and fam.name not in section
     )
+
+
+def undocumented_wire_metrics(readme_path=None) -> List[str]:
+    """Wire data-plane metric names missing from the README's
+    "Wire modes" section specifically (the device-table posture: a name
+    mentioned elsewhere in the file does not count as documented here)."""
+    if readme_path is None:
+        readme_path = REPO_ROOT / "README.md"
+    text = pathlib.Path(readme_path).read_text()
+    # anchor on the HEADING: cross-references ("see **Wire modes**")
+    # elsewhere in the file must not shadow the real section
+    anchor = text.find("## Wire modes")
+    if anchor >= 0:
+        end = text.find("\n## ", anchor)
+        section = text[anchor:] if end < 0 else text[anchor:end]
+    else:
+        section = ""
+    return sorted(n for n in _WIRE_METRIC_NAMES if n not in section)
 
 
 def missing_readme_sections(readme_path=None) -> List[str]:
@@ -145,6 +172,21 @@ def main(argv=None) -> int:
         print(
             "device-metric lint ok: every device metric is in the Device "
             "telemetry table"
+        )
+    missing_wire = undocumented_wire_metrics()
+    if missing_wire:
+        print(
+            "wire data-plane metrics missing from README.md's Wire modes "
+            "section:",
+            file=sys.stderr,
+        )
+        for name in missing_wire:
+            print(f"  {name}", file=sys.stderr)
+        rc = 1
+    else:
+        print(
+            "wire-metric lint ok: every wire metric is in the Wire modes "
+            "section"
         )
     missing_sections = missing_readme_sections()
     if missing_sections:

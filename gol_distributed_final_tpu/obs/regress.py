@@ -184,10 +184,13 @@ def compare_case(
     old_us, new_us = old.get("per_turn_us"), new.get("per_turn_us")
     out = {"old_us": old_us, "new_us": new_us}
     # symmetric: a zero/missing fit on EITHER side is a broken
-    # measurement, never an infinite improvement or regression
+    # measurement, never an infinite improvement or regression. The byte
+    # gate still applies below: byte accounting survives a broken
+    # wall-clock fit (e.g. a salvaged round), and a deterministic comms
+    # regression must not hide behind an unusable timing.
     if not old_us or not new_us:
         out["verdict"] = "incomparable"
-        return out
+        return _apply_wire_bytes_gate(old, new, out, threshold)
     delta = new_us - old_us
     rel = delta / old_us
     noises = [
@@ -207,6 +210,26 @@ def compare_case(
         out["verdict"] = "REGRESSED" if rel > threshold else "slower"
     else:
         out["verdict"] = "improved" if -rel > threshold else "faster"
+    return _apply_wire_bytes_gate(old, new, out, threshold)
+
+
+def _apply_wire_bytes_gate(
+    old: dict, new: dict, out: dict, threshold: float
+) -> dict:
+    """The comms meter rides along on wire-mode cases
+    (``wire_bytes_per_turn`` from gol_wire_bytes_total): byte accounting
+    is deterministic — no noise band — so growth past the threshold gates
+    even when the wall-clock verdict was clean OR unusable. The comms win
+    is a contract, not a side effect."""
+    old_b, new_b = old.get("wire_bytes_per_turn"), new.get("wire_bytes_per_turn")
+    if old_b and new_b:
+        bytes_rel = (new_b - old_b) / old_b
+        out["old_bytes"] = old_b
+        out["new_bytes"] = new_b
+        out["bytes_delta_pct"] = 100.0 * bytes_rel
+        if bytes_rel > threshold:
+            out["verdict"] = "REGRESSED"
+            out["why"] = "wire bytes/turn grew past threshold"
     return out
 
 

@@ -115,6 +115,41 @@ def _rpc_lines(snap: dict) -> List[str]:
     return out
 
 
+def _wire_lines(snap: dict) -> List[str]:
+    """The data-plane comms column: frame bytes this process's RPC
+    clients moved per verb and direction (``gol_wire_bytes_total`` — the
+    broker's scatter/StripStep traffic when polling a broker), the
+    turns-per-batch histogram (``gol_turn_batch_size``: K in resident
+    wire mode, 1 in full/haloed), and the resident full-resync count."""
+    by_verb: Dict[str, Dict[str, float]] = {}
+    for labels, series in _series_map(snap, "gol_wire_bytes_total").items():
+        if len(labels) != 2:
+            continue
+        verb, direction = labels
+        by_verb.setdefault(verb, {})[direction] = series.get("value") or 0.0
+    batch = _series_map(snap, "gol_turn_batch_size").get(())
+    resyncs = _scalar(snap, "gol_strip_resync_total")
+    if not by_verb and not batch and not resyncs:
+        return []
+    out = ["WIRE (data plane)          sent        received"]
+    for verb in sorted(by_verb):
+        d = by_verb[verb]
+        out.append(
+            f"  {verb:<24} {_human_bytes(d.get('sent')):>9}  "
+            f"{_human_bytes(d.get('received')):>9}"
+        )
+    tail = []
+    if batch:
+        count, mean = _hist_stats(batch)
+        if count:
+            tail.append(f"batches {count:,} (mean {mean:.1f} turns/rpc)")
+    if resyncs:
+        tail.append(f"strip resyncs {int(resyncs)}")
+    if tail:
+        out.append("  " + "   ".join(tail))
+    return out
+
+
 def _worker_lines(payload: dict) -> List[str]:
     """The broker's roster health column (WorkersBackend.worker_health)
     plus the fault-tolerance counters: who is connected, who is lost and
@@ -238,6 +273,7 @@ def render_status(
     sections = [
         _throughput_lines(snap, turns_rate),
         _rpc_lines(snap),
+        _wire_lines(snap),
         _worker_lines(payload),
         _compile_lines(snap),
         _hbm_lines(snap),

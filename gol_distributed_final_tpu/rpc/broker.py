@@ -12,7 +12,9 @@ Two interchangeable data-plane backends behind the same RPC verbs:
   (broker/broker.go:135-224), preserved for contract parity. By default
   strips are sent with 2 halo rows (O(strip) wire cost); ``-wire full``
   selects the reference-EXACT behavior of shipping the whole board to
-  every worker (broker/broker.go:144).
+  every worker (broker/broker.go:144); ``-wire resident`` keeps each
+  strip RESIDENT on its worker and moves only 2*K halo rows per K-turn
+  batch (``-halo-depth K``, ``-sync-interval`` full re-syncs).
 
 Control semantics preserved: Run blocks and resets state; Pause toggles;
 Quit breaks the loop but keeps the process alive for a reattaching
@@ -198,16 +200,40 @@ class TpuBackend:
         return self.engine.retrieve(include_world=include_world)
 
 
+class _ResidentPlan:
+    """One seeded resident-strip deployment: which client holds which rows,
+    the batch depth K, and each strip's boundary rows at the current turn
+    (``edges[i] = (top K rows, bottom K rows)``) — the only state that has
+    to move per batch (the broker relays worker i-1's bottom edge and
+    worker i+1's top edge down as worker i's next halos)."""
+
+    __slots__ = ("active", "bounds", "k", "edges")
+
+    def __init__(self, active, bounds, k, edges):
+        self.active = active
+        self.bounds = bounds
+        self.k = k
+        self.edges = edges
+
+
 class WorkersBackend:
     """Reference-shaped scatter/gather over remote workers
     (broker/broker.go:62-234).
 
-    ``wire`` picks what a scatter ships: ``"haloed"`` (default) sends each
-    worker its strip plus the two wrap halo rows — O(strip) bytes; ``"full"``
-    is the reference-EXACT wire behavior, the whole board to every worker
-    with [start_y, end_y) bounds (broker/broker.go:144 — O(H x W) bytes per
-    worker per turn, the scalability limit README.md:204 points at,
-    preserved for contract archaeology)."""
+    ``wire`` picks the data plane: ``"haloed"`` (default) sends each
+    worker its strip plus the two wrap halo rows — O(strip) bytes per turn;
+    ``"full"`` is the reference-EXACT wire behavior, the whole board to
+    every worker with [start_y, end_y) bounds (broker/broker.go:144 —
+    O(H x W) bytes per worker per turn, the scalability limit
+    README.md:204 points at, preserved for contract archaeology);
+    ``"resident"`` makes the workers STATEFUL: each strip stays where it is
+    computed (StripStart seeds it), only the 2·K boundary rows move per
+    K-turn batch (StripStep — O(W·K) bytes and 1/K round-trips per turn),
+    and full strips are gathered back (StripFetch) only every
+    ``sync_interval`` turns and at snapshot/pause/checkpoint/run-end
+    boundaries. ``halo_depth`` is the resident batch depth K — the same
+    comms/compute amortisation the mesh planes' wide halos buy on-device
+    (parallel/halo.py), honored on this backend for the first time."""
 
     def __init__(
         self,
@@ -217,14 +243,30 @@ class WorkersBackend:
         rpc_deadline: float | None = None,
         auto_checkpoint: tuple[float, str] | None = None,
         probe_interval: float = 1.0,
+        halo_depth: int = 1,
+        sync_interval: int = 256,
     ):
-        if wire not in ("haloed", "full"):
-            raise ValueError(f"wire must be 'haloed' or 'full', got {wire!r}")
+        if wire not in ("haloed", "full", "resident"):
+            raise ValueError(
+                f"wire must be 'haloed', 'full' or 'resident', got {wire!r}"
+            )
         if probe_interval <= 0:
             # 0 would busy-spin the probe thread and connect-storm every
             # dead address (next-probe times of now+0 forever)
             raise ValueError(f"probe_interval must be > 0, got {probe_interval}")
+        if halo_depth < 1:
+            raise ValueError(f"halo_depth must be >= 1, got {halo_depth}")
+        if sync_interval < 0:
+            raise ValueError(
+                f"sync_interval must be >= 0 (0: boundary syncs only), "
+                f"got {sync_interval}"
+            )
         self._wire = wire
+        self._halo_depth = halo_depth  # resident batch depth K (server default)
+        # resident mode: turns between periodic full re-syncs (bounds the
+        # local recompute a loss recovery pays); 0 = only at snapshot/
+        # pause/checkpoint/run-end boundaries and losses
+        self._sync_interval = sync_interval
         # None: adaptive (EWMA of observed turn time — _scatter_deadline);
         # a float pins every scatter's reply bound (the -rpc-deadline flag)
         self._rpc_deadline = rpc_deadline
@@ -260,6 +302,14 @@ class WorkersBackend:
         )
         self._world: np.ndarray | None = None
         self._turn = 0
+        # resident-mode bookkeeping: the turn self._world is CURRENT at
+        # (== self._turn in the full/haloed modes, which commit a fresh
+        # world every turn), the pending snapshot-sync request, and the
+        # latest (turn, alive_count) sample every wire mode records through
+        # _record_alive — the count-only Retrieve feed
+        self._sync_turn = 0
+        self._sync_requested = False
+        self._alive: tuple[int, int] | None = None
         self._paused = False
         self._parked = False  # turn loop is actually waiting in the gate
         self._quit = False
@@ -273,13 +323,13 @@ class WorkersBackend:
             raise RpcError("no workers connected")
         # extension fields via getattr: an older client's pickle lacks
         # them, and absent must mean "default", not AttributeError
-        if getattr(req, "halo_depth", 0) > 1:
-            # wide halos are a mesh-plane knob; the reference-shaped
-            # scatter/gather has no equivalent — refuse rather than
-            # silently running at depth 1
+        if getattr(req, "halo_depth", 0) > 1 and self._wire != "resident":
+            # wide halos need stateful strips; the per-turn scatter/gather
+            # wires have no equivalent — refuse rather than silently
+            # running at depth 1
             raise RpcError(
-                "the workers backend has no halo_depth knob; use "
-                "-backend tpu for wide halos"
+                "this wire mode has no halo_depth knob; use -wire resident "
+                "(or -backend tpu) for wide halos"
             )
         if getattr(req, "rulestring", ""):
             # the reference-shaped workers hard-code Conway
@@ -304,6 +354,9 @@ class WorkersBackend:
             if self._running:
                 raise RpcError("a run is already in progress")
             self._world, self._turn = world, initial_turn
+            self._sync_turn = initial_turn
+            self._sync_requested = False
+            self._record_alive(initial_turn, int(np.count_nonzero(world)))
             self._paused = False
             self._parked = False
             self._running = True
@@ -335,6 +388,12 @@ class WorkersBackend:
         return bounds
 
     def _turn_loop(self, req: Request, h: int, initial_turn: int = 0) -> None:
+        if self._wire == "resident":
+            self._resident_turn_loop(req, h, initial_turn)
+        else:
+            self._scatter_turn_loop(req, h, initial_turn)
+
+    def _scatter_turn_loop(self, req: Request, h: int, initial_turn: int = 0) -> None:
         """Per-turn scatter/gather with elastic recovery: a worker that dies
         OR exceeds the per-scatter deadline mid-turn is dropped and its rows
         re-split over the survivors (the same turn is recomputed from the
@@ -345,32 +404,19 @@ class WorkersBackend:
         import concurrent.futures
 
         def scatter(client, world, s, e, deadline, trace_parent=None):
-            # trace_parent: this call runs on a POOL thread where the turn
-            # span's thread-local stack is invisible — the parent must ride
-            # in explicitly for the per-worker Update spans to join the
-            # turn (and through it the caller's whole session trace). Only
-            # passed when tracing set it (like the controller's rule=
-            # kwarg): worker clients are duck-typed and fakes only need
-            # the timeout kwarg. ``deadline`` bounds the REPLY wait: a
-            # wedged worker costs one deadline, never the whole run.
-            kw = {"timeout": deadline}
-            if trace_parent is not None:
-                kw["trace_parent"] = trace_parent
+            # _call_worker handles the pool-thread plumbing: deadline
+            # bounds the REPLY wait (a wedged worker costs one deadline,
+            # never the whole run) and trace_parent rides in explicitly
+            # (the turn span's thread-local stack is invisible here).
             if self._wire == "full":
                 # reference-exact: ship the whole board, worker slices
-                res = client.call(
-                    Methods.WORKER_UPDATE,
-                    Request(world=world, start_y=s, end_y=e),
-                    **kw,
-                )
+                req = Request(world=world, start_y=s, end_y=e)
             else:
                 rows = np.arange(s - 1, e + 1) % h
-                res = client.call(
-                    Methods.WORKER_UPDATE,
-                    Request(world=world[rows], start_y=-1),
-                    **kw,
-                )
-            return res.work_slice
+                req = Request(world=world[rows], start_y=-1)
+            return self._call_worker(
+                client, Methods.WORKER_UPDATE, req, deadline, trace_parent
+            ).work_slice
 
         def plan(active):
             n = max(1, min(req.threads or len(active), len(active), h))
@@ -421,42 +467,11 @@ class WorkersBackend:
                             )
                             for i in range(n)
                         ]
-                        strips = [None] * n
-                        dead = []
-                        # the gather itself is time-bounded too: the client
-                        # deadline only covers the reply wait, so a scatter
-                        # thread stuck in sendall (peer stopped draining)
-                        # must not hang fut.result() past roughly one
-                        # deadline. The send allowance scales with the
-                        # observed turn time (which includes serialisation
-                        # + send): a pinned small -rpc-deadline with big
-                        # -wire full frames must not evict healthy workers
-                        # still legitimately inside sendall. Before any
-                        # clean turn has committed there is no estimate, so
-                        # the allowance is the cold bound — a turn-1 stuck
-                        # send costs more, an honest turn-1 big send never
-                        # evicts the roster
-                        send_allowance = (
-                            10.0 * self._turn_seconds
-                            if self._turn_seconds is not None
-                            else _DEADLINE_COLD
-                        )
-                        t_gather = (
-                            time.monotonic() + deadline + _DEADLINE_GRACE
-                            + send_allowance
-                        )
-                        for i, fut in enumerate(futures):
-                            try:
-                                strips[i] = fut.result(
-                                    timeout=max(0.0, t_gather - time.monotonic())
-                                )
-                            except (
-                                RpcError,
-                                OSError,
-                                TimeoutError,
-                                concurrent.futures.TimeoutError,
-                            ):
-                                dead.append(i)
+                        # _bounded_gather time-bounds the gather beyond the
+                        # reply deadline (a scatter thread stuck in sendall
+                        # must not hang fut.result() — the send allowance
+                        # rationale lives on the helper)
+                        strips, dead = self._bounded_gather(futures, deadline)
                         if not dead:
                             break
                         with self._lock:
@@ -475,9 +490,13 @@ class WorkersBackend:
                         )
 
                     new_world = np.concatenate(strips, axis=0)
+                    count = int(np.count_nonzero(new_world))  # outside the lock
                     with self._lock:
                         self._world = new_world
                         self._turn += 1
+                        self._sync_turn = self._turn  # a fresh full world
+                        self._record_alive(self._turn, count)
+                    _ins.TURN_BATCH_SIZE.observe(1)
                 finally:
                     # ends on every exit — commit, shutdown race, all-lost
                     # raise — so a wedged NEXT turn is the one left open
@@ -502,7 +521,418 @@ class WorkersBackend:
             # peer's kernel's business) must not hang the run's return
             pool.shutdown(wait=False)
 
+    # -- the resident-strip data plane (-wire resident) ----------------------
+
+    def _call_worker(self, client, method, req, deadline, trace_parent=None):
+        """One bounded worker call on a pool thread (the scatter posture:
+        timeout covers the REPLY wait; trace_parent only when tracing set
+        it, so duck-typed fakes survive)."""
+        kw = {"timeout": deadline}
+        if trace_parent is not None:
+            kw["trace_parent"] = trace_parent
+        return client.call(method, req, **kw)
+
+    def _bounded_gather(self, futures, deadline):
+        """``(results, dead_indices)`` with the scatter loop's time bound:
+        the client deadline covers only the reply wait, so each future is
+        additionally bounded by deadline + grace + a send allowance (a
+        peer that stopped draining its receive buffer must cost one
+        deadline, never hang the run)."""
+        import concurrent.futures
+
+        send_allowance = (
+            10.0 * self._turn_seconds
+            if self._turn_seconds is not None
+            else _DEADLINE_COLD
+        )
+        t_gather = (
+            time.monotonic() + deadline + _DEADLINE_GRACE + send_allowance
+        )
+        results, dead = [None] * len(futures), []
+        for i, fut in enumerate(futures):
+            try:
+                results[i] = fut.result(
+                    timeout=max(0.0, t_gather - time.monotonic())
+                )
+            except (
+                RpcError,
+                OSError,
+                TimeoutError,
+                concurrent.futures.TimeoutError,
+            ):
+                dead.append(i)
+        return results, dead
+
+    def _recompute_rows(
+        self, world: np.ndarray, s: int, e: int, steps: int
+    ) -> np.ndarray:
+        """Rows [s, e) at ``steps`` turns past ``world``, by local shrinking
+        recompute over the dependency cone (the rows within ``steps`` of
+        the target, toroidal row wrap) — the workers' own numpy kernel
+        (rpc/worker._strip_step), so the rebuild is bit-identical to what
+        a worker would have computed."""
+        from .worker import _strip_step, compute_strip
+
+        h = world.shape[0]
+        if (e - s) + 2 * steps >= h:
+            # the cone covers the whole board: plain full-board stepping
+            # is cheaper than a wider-than-the-board block
+            for _ in range(steps):
+                world = compute_strip(world, 0, h)
+            return world[s:e]
+        block = world[np.arange(s - steps, e + steps) % h]
+        for _ in range(steps):
+            block = _strip_step(block)  # 2 fewer rows per step
+        return block
+
+    def _resident_seed(self, req, h: int, depth: int, pool, tp=None):
+        """Deploy (or re-deploy) the resident plan: split the current full
+        board — which the plan-is-None invariant guarantees is at
+        ``self._turn`` — over the active clients and ``StripStart`` each.
+        Loops over losses (a worker dead at seed time is marked lost and
+        the split re-planned); returns None on quit."""
+        while True:
+            with self._lock:
+                if self._quit:
+                    return None
+                active = list(self.clients)
+                world, turn = self._world, self._turn
+            if not active:
+                raise RpcError("all workers lost mid-run")
+            n = max(1, min(req.threads or len(active), len(active), h))
+            active = active[:n]
+            bounds = self._split(h, n)
+            # the batch depth K: the -halo-depth knob clamped to the
+            # thinnest strip (a worker cannot relay more edge rows than
+            # its strip holds)
+            k = max(1, min(depth, min(e - s for s, e in bounds)))
+            deadline = self._scatter_deadline()
+            futures = [
+                pool.submit(
+                    self._call_worker,
+                    active[i],
+                    Methods.STRIP_START,
+                    Request(
+                        world=world[bounds[i][0]:bounds[i][1]],
+                        worker=i,
+                        initial_turn=turn,
+                    ),
+                    deadline,
+                    tp,
+                )
+                for i in range(n)
+            ]
+            _, dead = self._bounded_gather(futures, deadline)
+            if not dead:
+                edges = [
+                    (world[s:s + k], world[e - k:e]) for s, e in bounds
+                ]
+                return _ResidentPlan(active, bounds, k, edges)
+            for i in dead:
+                self._mark_lost(active[i], "resident seed failed")
+
+    def _resident_sync(self, plan, pool, tp=None) -> bool:
+        """Gather every resident strip (``StripFetch``) and refresh the
+        broker's full board at the committed turn. True on success; False
+        after marking failures — or lockstep-diverged strips — lost (the
+        caller then recovers and reseeds)."""
+        with self._lock:
+            turn = self._turn
+        deadline = self._scatter_deadline()
+        futures = [
+            pool.submit(
+                self._call_worker, c, Methods.STRIP_FETCH,
+                Request(worker=i), deadline, tp,
+            )
+            for i, c in enumerate(plan.active)
+        ]
+        results, dead = self._bounded_gather(futures, deadline)
+        ok = True
+        for i in dead:
+            self._mark_lost(plan.active[i], "resident sync failed")
+            ok = False
+        for i, res in enumerate(results):
+            if res is None:
+                continue
+            s, e = plan.bounds[i]
+            strip = np.asarray(res.work_slice, np.uint8)
+            if res.turns_completed != turn or strip.shape[0] != e - s:
+                # between batches every strip must sit at the committed
+                # turn — a divergence means this worker's session is not
+                # the one we seeded (never silently assemble it)
+                self._mark_lost(plan.active[i], "resident lockstep divergence")
+                ok = False
+        if not ok:
+            return False
+        # concatenate copies out of the receive-buffer views (protocol-5
+        # sidecars), so the world outlives the frames it arrived in
+        world = np.concatenate(
+            [np.asarray(r.work_slice, np.uint8) for r in results], axis=0
+        )
+        with self._lock:
+            self._world = world
+            self._sync_turn = turn
+        _ins.STRIP_RESYNC_TOTAL.inc()
+        return True
+
+    def _resident_recover(self, plan, pool, tp=None) -> None:
+        """After a loss: rebuild the full board at the committed turn.
+        Survivor strips still AT the committed turn are fetched and
+        contribute their rows verbatim; rows held by lost workers — or by
+        survivors that already advanced past the commit inside the failed
+        batch — are reconstructed locally from the last full sync
+        (bit-identical, worker-kernel recompute), so recovery costs
+        O(board) work once per loss, bounded by ``-sync-interval``,
+        instead of reverting the run."""
+        with self._lock:
+            base, t0, t1 = self._world, self._sync_turn, self._turn
+            alive = {id(c) for c in self.clients}
+        if t1 == t0:
+            return  # the loss landed at a boundary: world already current
+        parts: dict[int, np.ndarray] = {}
+        survivors = [
+            (i, c) for i, c in enumerate(plan.active) if id(c) in alive
+        ]
+        if survivors:
+            deadline = self._scatter_deadline()
+            futures = [
+                pool.submit(
+                    self._call_worker, c, Methods.STRIP_FETCH,
+                    Request(worker=i), deadline, tp,
+                )
+                for i, c in survivors
+            ]
+            results, dead = self._bounded_gather(futures, deadline)
+            for j in dead:
+                self._mark_lost(survivors[j][1], "resident recovery fetch failed")
+            for j, res in enumerate(results):
+                if res is None:
+                    continue
+                i = survivors[j][0]
+                s, e = plan.bounds[i]
+                strip = np.asarray(res.work_slice, np.uint8)
+                # only a strip at exactly the committed turn is usable;
+                # one that finished the failed batch (t1 + k) is healthy
+                # but ahead — its rows are reconstructed instead
+                if res.turns_completed == t1 and strip.shape == (e - s, base.shape[1]):
+                    parts[i] = strip
+        world = np.empty_like(base)
+        steps = t1 - t0
+        for i, (s, e) in enumerate(plan.bounds):
+            if i in parts:
+                world[s:e] = parts[i]
+            else:
+                world[s:e] = self._recompute_rows(base, s, e, steps)
+        with self._lock:
+            self._world = world
+            self._sync_turn = t1
+        _ins.STRIP_RESYNC_TOTAL.inc()
+
+    def _resident_turn_loop(self, req, h: int, initial_turn: int = 0) -> None:
+        """The stateful data plane: strips stay on the workers (seeded by
+        ``StripStart``), each K-turn batch moves only the 2·K boundary
+        rows per worker (``StripStep`` — O(W·K) bytes, one round-trip per
+        K turns), and the full board is gathered back (``StripFetch``)
+        only at ``-sync-interval`` expiries and snapshot/pause/checkpoint/
+        run-end boundaries. Lockstep contract: between batches every
+        seeded strip is at ``self._turn``; a loss costs one recovery
+        rebuild + reseed, never the run."""
+        import concurrent.futures
+
+        depth = getattr(req, "halo_depth", 0) or self._halo_depth
+        pool_size = max(1, len(self.clients), len(self.addresses))
+        pool = concurrent.futures.ThreadPoolExecutor(pool_size)
+        plan = None
+        try:
+            while True:
+                with self._lock:
+                    if self._quit:
+                        return
+                    paused = self._paused
+                    behind = self._sync_turn != self._turn
+                    done = self._turn >= req.turns
+                    want_sync = behind and (
+                        done
+                        or paused
+                        or self._sync_requested
+                        or self._ckpt_due()
+                        or (
+                            self._sync_interval
+                            and self._turn - self._sync_turn
+                            >= self._sync_interval
+                        )
+                    )
+                if want_sync:
+                    if plan is not None and not self._resident_sync(plan, pool):
+                        self._resident_recover(plan, pool)
+                        plan = None
+                    with self._lock:
+                        if self._sync_turn == self._turn:
+                            self._sync_requested = False
+                            self._control.notify_all()
+                    continue
+                if done:
+                    return
+                if paused:
+                    # park only with the world synced (the block above ran
+                    # first): a retrieve while parked sees the current board
+                    with self._lock:
+                        while self._paused and not self._quit:
+                            self._parked = True
+                            self._control.notify_all()
+                            self._control.wait()
+                        self._parked = False
+                        if self._quit:
+                            return
+                    continue
+                if plan is not None:
+                    # roster drift (the probe readmitted a worker, or the
+                    # thread cap changed the prefix): bring the world
+                    # current and reseed so the split RE-EXPANDS
+                    with self._lock:
+                        active = list(self.clients)
+                    n = max(1, min(req.threads or len(active), len(active), h))
+                    if active[:n] != plan.active:
+                        if behind and not self._resident_sync(plan, pool):
+                            self._resident_recover(plan, pool)
+                        plan = None
+                if plan is None:
+                    plan = self._resident_seed(req, h, depth, pool)
+                    if plan is None:
+                        return  # quit during seeding
+                    continue  # re-evaluate gates with the fresh plan
+
+                # -- one K-turn batch ---------------------------------------
+                with self._lock:
+                    turn0 = self._turn
+                k = min(plan.k, req.turns - turn0)
+                n = len(plan.active)
+                turn_span = (
+                    _tracing.start_span(
+                        _tracing.SPAN_BROKER_TURN, turn=turn0, batch=k
+                    )
+                    if _tracing.enabled() else None
+                )
+                tp = turn_span.ctx() if turn_span else None
+                t_batch = time.monotonic()
+                try:
+                    deadline = self._scatter_deadline()
+                    futures = []
+                    for i in range(n):
+                        # the worker's next halos are its neighbours'
+                        # boundary rows at turn0: the strip above
+                        # contributes its LAST k rows, the strip below its
+                        # FIRST k (n == 1 wraps onto itself)
+                        top = plan.edges[(i - 1) % n][1][-k:]
+                        bottom = plan.edges[(i + 1) % n][0][:k]
+                        futures.append(
+                            pool.submit(
+                                self._call_worker,
+                                plan.active[i],
+                                Methods.STRIP_STEP,
+                                Request(
+                                    world=np.concatenate([top, bottom], axis=0),
+                                    worker=i,
+                                    turns=k,
+                                    initial_turn=turn0,
+                                ),
+                                deadline,
+                                tp,
+                            )
+                        )
+                    results, dead = self._bounded_gather(futures, deadline)
+                    for i, res in enumerate(results):
+                        if res is None:
+                            continue
+                        edges = getattr(res, "edges", None)
+                        if (
+                            res.turns_completed != turn0 + k
+                            or edges is None
+                            or edges.shape[0] != 2 * k
+                        ):
+                            # a malformed success is a protocol violation,
+                            # not a committable strip
+                            dead.append(i)
+                            results[i] = None
+                    if dead:
+                        with self._lock:
+                            if self._quit:
+                                return  # shutdown race, not a failure
+                        for i in sorted(set(dead)):
+                            self._mark_lost(plan.active[i], "strip step failed")
+                        _ins.TURN_RETRY_TOTAL.inc()
+                        with self._lock:
+                            left = len(self.clients)
+                        logger.warning(
+                            "%d worker(s) lost mid-batch at turn %d; "
+                            "recovering over %d",
+                            len(set(dead)), turn0, left,
+                        )
+                        self._resident_recover(plan, pool, tp)
+                        plan = None
+                        continue
+                    # commit: every strip advanced turn0 -> turn0 + k in
+                    # lockstep; only the fresh boundary rows came back.
+                    # The ticker feed needs the LANDING turn's count only
+                    # (each reply's counts[-1] — the intermediate steps
+                    # are unobservable between batches)
+                    total = 0
+                    for res in results:
+                        counts = getattr(res, "counts", None) or []
+                        if counts:
+                            total += int(counts[-1])
+                    for i, res in enumerate(results):
+                        plan.edges[i] = (res.edges[:k], res.edges[k:])
+                    with self._lock:
+                        self._turn = turn0 + k
+                        self._record_alive(turn0 + k, total)
+                    _ins.TURN_BATCH_SIZE.observe(k)
+                finally:
+                    _tracing.end_span(turn_span)
+                # clean batches only, like the scatter loop; the EWMA unit
+                # here is one BATCH (what one deadline must cover)
+                dt = time.monotonic() - t_batch
+                self._turn_seconds = (
+                    dt if self._turn_seconds is None
+                    else 0.9 * self._turn_seconds + 0.1 * dt
+                )
+                _faults.fault_point("broker.turn_commit")
+                self._maybe_auto_checkpoint()
+        finally:
+            # every exit ships a current board (the Run/Retrieve contract):
+            # best-effort fetch, falling back to the local rebuild
+            with self._lock:
+                behind = self._sync_turn != self._turn
+            if behind:
+                if plan is None or not self._resident_sync(plan, pool):
+                    if plan is not None:
+                        self._resident_recover(plan, pool)
+            with self._lock:
+                self._control.notify_all()  # wake any sync-waiting retrieve
+            pool.shutdown(wait=False)
+
+    def _record_alive(self, turn: int, count: int) -> None:
+        """THE alive-count feed for every wire mode: ``retrieve`` serves
+        the 2-second AliveCellsCount ticker from this sample instead of
+        counting a gathered board — in resident mode there is no per-turn
+        board to count, and one shared helper keeps the backends from
+        drifting. Caller must hold ``self._lock`` and record in the SAME
+        critical section that commits ``self._turn``: a ticker retrieve
+        between the two would otherwise pair the new turn with a stale
+        count (in resident mode the fallback board is the last sync —
+        up to -sync-interval turns old)."""
+        self._alive = (turn, count)
+
     # -- fault tolerance ---------------------------------------------------
+
+    def _ckpt_due(self) -> bool:
+        """Whether the time-based auto-checkpoint wants to write — split
+        out so the resident loop can sync the world FIRST (the checkpoint
+        snapshots the last synced board; without the pre-sync it would
+        always trail by up to -sync-interval turns)."""
+        return bool(self._auto_checkpoint) and (
+            time.monotonic() - self._last_ckpt >= self._auto_checkpoint[0]
+        )
 
     def _scatter_deadline(self) -> float:
         """Reply bound for one scatter call. ``-rpc-deadline`` pins it;
@@ -624,7 +1054,11 @@ class WorkersBackend:
             return
         self._last_ckpt = now  # interval pacing even across failures
         with self._lock:
-            world, turn = self._world, self._turn
+            # the SYNC turn, not the committed turn: in resident mode the
+            # broker's board trails the workers between syncs (the loop
+            # pre-syncs when _ckpt_due, so this is normally current), and
+            # a checkpoint must never pair a stale board with a newer turn
+            world, turn = self._world, self._sync_turn
         from ..engine.checkpoint import npz_path, save_checkpoint
         from ..models import CONWAY
 
@@ -728,15 +1162,56 @@ class WorkersBackend:
             finally:
                 client.close()
 
+    def close(self) -> None:
+        """Release the broker side only: stop the readmission probe and
+        close the worker clients. The workers keep running — SuperQuit is
+        the verb that takes THEM down (bench.py and tests tear down
+        in-process backends through this without killing the cluster)."""
+        self._probe_stop.set()
+        with self._lock:
+            clients, self.clients = list(self.clients), []
+            self._client_addr.clear()
+            self._lost.clear()
+        for client in clients:
+            try:
+                client.close()
+            except Exception:
+                pass
+
     def retrieve(self, include_world: bool) -> Snapshot:
         with self._lock:
+            if (
+                include_world
+                and self._wire == "resident"
+                and self._running
+                and self._sync_turn != self._turn
+            ):
+                # snapshot boundary: ask the turn loop for a full re-sync
+                # (StripFetch) and wait for it — the resident board lives
+                # on the workers between syncs
+                self._sync_requested = True
+                self._control.notify_all()
+                self._control.wait_for(
+                    lambda: not self._running
+                    or self._sync_turn == self._turn,
+                    timeout=60.0,
+                )
             world = self._world
             turn = self._turn
+            alive = self._alive
+            if include_world and self._sync_turn != turn:
+                # the wait timed out mid-batch (a wedge being paid for):
+                # serve a CONSISTENT (board, turn) pair from the last
+                # sync rather than a newer turn number on an older board
+                turn = self._sync_turn
+                alive = None
         if world is None:
             return Snapshot(np.zeros((0, 0), np.uint8), 0, 0)
-        return Snapshot(
-            world if include_world else None, turn, int(np.count_nonzero(world))
-        )
+        if alive is not None and alive[0] == turn:
+            count = alive[1]  # the shared per-turn feed (_record_alive)
+        else:
+            count = int(np.count_nonzero(world))
+        return Snapshot(world if include_world else None, turn, count)
 
     def collect_remote_spans(self) -> list:
         """Each connected worker's finished spans, via its own Status verb
@@ -954,6 +1429,7 @@ def serve(
     auto_checkpoint: tuple[float, str] | None = None,
     resume=None,
     probe_interval: float = 1.0,
+    sync_interval: int = 256,
 ) -> tuple[RpcServer, BrokerService]:
     server = RpcServer(host=host, port=port)
     impl = (
@@ -963,6 +1439,8 @@ def serve(
             rpc_deadline=rpc_deadline,
             auto_checkpoint=auto_checkpoint,
             probe_interval=probe_interval,
+            halo_depth=halo_depth,
+            sync_interval=sync_interval,
         )
         if backend == "workers"
         else TpuBackend(halo_depth=halo_depth)
@@ -994,15 +1472,27 @@ def main(argv=None) -> None:
         help="bind address; 0.0.0.0 opts into external exposure",
     )
     parser.add_argument(
-        "-wire", choices=("haloed", "full"), default="haloed",
-        help="workers-backend scatter payload: haloed strips (O(strip) "
-             "bytes, default) or the reference-exact full board "
-             "(broker/broker.go:144)",
+        "-wire", choices=("haloed", "full", "resident"), default="haloed",
+        help="workers-backend data plane: haloed strips (O(strip) bytes "
+             "per turn, default), the reference-exact full board "
+             "(broker/broker.go:144), or resident strips (stateful "
+             "workers — only 2*K halo rows move per K-turn batch, K from "
+             "-halo-depth; full boards gathered every -sync-interval "
+             "turns and at snapshot/pause/checkpoint boundaries)",
     )
     parser.add_argument(
         "-halo-depth", dest="halo_depth", type=int, default=1,
-        help="tpu backend: turns per halo exchange on the mesh planes "
-             "(wide halos — raise on DCN-crossed meshes)",
+        help="turns per halo exchange: on the tpu backend the mesh "
+             "planes' wide-halo depth; with -wire resident the workers "
+             "backend's batch depth K (K turns per StripStep round-trip)",
+    )
+    parser.add_argument(
+        "-sync-interval", dest="sync_interval", type=int, default=256,
+        metavar="TURNS",
+        help="-wire resident: turns between periodic full strip "
+             "re-syncs (bounds the local recompute a loss recovery pays; "
+             "0 = only at snapshot/pause/checkpoint/run-end boundaries "
+             "and losses)",
     )
     parser.add_argument(
         "-rpc-deadline", dest="rpc_deadline", type=float, default=0.0,
@@ -1060,8 +1550,22 @@ def main(argv=None) -> None:
         flight.enable()
     if args.halo_depth < 1:
         parser.error(f"-halo-depth must be >= 1, got {args.halo_depth}")
-    if args.halo_depth > 1 and args.backend != "tpu":
-        parser.error("-halo-depth is a tpu-backend knob (mesh planes)")
+    if (
+        args.halo_depth > 1
+        and args.backend == "workers"
+        and args.wire != "resident"
+    ):
+        parser.error(
+            "-halo-depth on the workers backend needs -wire resident "
+            "(stateful strips); the per-turn scatter wires have no "
+            "batching to honor it"
+        )
+    if args.sync_interval < 0:
+        parser.error(
+            f"-sync-interval must be >= 0, got {args.sync_interval}"
+        )
+    if args.sync_interval != 256 and args.wire != "resident":
+        parser.error("-sync-interval is a -wire resident knob")
     if args.rpc_deadline < 0:
         parser.error(f"-rpc-deadline must be >= 0, got {args.rpc_deadline}")
     if args.probe_interval <= 0:
@@ -1109,6 +1613,7 @@ def main(argv=None) -> None:
         auto_checkpoint=auto_checkpoint,
         resume=resume,
         probe_interval=args.probe_interval,
+        sync_interval=args.sync_interval,
     )
     print(f"broker listening on :{server.port} (backend={args.backend})", flush=True)
     service.quit_event.wait()

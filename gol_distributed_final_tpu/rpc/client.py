@@ -101,6 +101,10 @@ class RpcClient:
         call captures both atomically: a send failure can then only ever
         tear down the connection the call actually used, never mark a
         fresh socket dead through a torn sock/closed pair."""
+        # protocol-5 negotiation state resets per transport: a reconnect
+        # may land on an older peer (rolling restart), which must re-prove
+        # out-of-band support before any flagged frame is sent to it
+        self._peer_oob = False
         closed = threading.Event()
         self._transport = (sock, closed)
         threading.Thread(
@@ -291,9 +295,15 @@ class RpcClient:
             raise RpcError("connection closed")
         try:
             with self._write_lock:
+                # "oob": 1 advertises this side parses protocol-5 sidecar
+                # frames (old servers ignore unknown envelope keys); the
+                # frame itself only upgrades once the PEER advertised in a
+                # reply — so an old server keeps receiving plain frames
                 sent = send_frame(
                     sock,
-                    {"id": call_id, "method": method, "request": request},
+                    {"id": call_id, "method": method, "request": request,
+                     "oob": 1},
+                    oob=self._peer_oob,
                 )
         except OSError as e:
             with self._pending_lock:
@@ -315,6 +325,7 @@ class RpcClient:
             raise RpcError(f"send failed: {e}") from e
         if _metrics.enabled():
             _ins.RPC_CLIENT_SENT_BYTES_TOTAL.labels(method).inc(sent)
+            _ins.WIRE_BYTES_TOTAL.labels(method, "sent").inc(sent)
         if not slot["event"].wait(timeout):
             with self._pending_lock:
                 self._pending.pop(call_id, None)
@@ -322,8 +333,15 @@ class RpcClient:
         reply = slot["reply"]
         if reply is None:
             raise RpcError("connection closed before reply")
+        if reply.get("oob"):
+            # the peer is new enough to both SEND the key and (being a
+            # current server) parse flagged frames: upgrade this transport
+            self._peer_oob = True
         if _metrics.enabled():
             _ins.RPC_CLIENT_RECEIVED_BYTES_TOTAL.labels(method).inc(
+                slot.get("reply_bytes", 0)
+            )
+            _ins.WIRE_BYTES_TOTAL.labels(method, "received").inc(
                 slot.get("reply_bytes", 0)
             )
         if "error" in reply:

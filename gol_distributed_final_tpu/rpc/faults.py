@@ -44,7 +44,14 @@ from typing import Optional
 # the proxy frames with the REAL wire header: a private-but-shared import
 # beats re-declaring the struct (a protocol framing change must re-frame
 # the chaos proxy too, not silently desync it)
-from .protocol import _HEADER, _recv_exact
+from .protocol import (
+    _FLAG_OOB,
+    _HEADER,
+    _LEN_MASK,
+    _OOB_LEN,
+    _OOB_SUB,
+    _recv_exact,
+)
 
 
 class FaultInjected(RuntimeError):
@@ -220,7 +227,13 @@ class ChaosProxy:
         try:
             while True:
                 head = _recv_exact(src, _HEADER.size)
-                (length,) = _HEADER.unpack(head)
+                (word,) = _HEADER.unpack(head)
+                # mask the protocol-5 out-of-band flag bit: a flagged
+                # header's length field is the body length either way, and
+                # the body (subheader + pickle + sidecar buffers) forwards
+                # as one opaque blob
+                oob = bool(word & _FLAG_OOB)
+                length = word & _LEN_MASK
                 payload = _recv_exact(src, length)
                 with self._lock:
                     idx = self._frames
@@ -240,16 +253,26 @@ class ChaosProxy:
                 corrupt = faults["corrupt_frame"]
                 if corrupt is not None and idx == corrupt and length:
                     body = bytearray(payload)
-                    # byte 0 is the pickle PROTO opcode: flipping it makes
-                    # the corruption land as a deterministic
-                    # UnpicklingError, never a silently-wrong board — so
-                    # the extra seeded flips must stay OFF byte 0 (one of
-                    # them landing there would flip it back to valid)
-                    body[0] ^= 0xFF
-                    if length > 1:
-                        rng = random.Random(self._seed ^ idx)
-                        for _ in range(3):
-                            body[rng.randrange(1, length)] ^= 0xFF
+                    # the corruption must land INSIDE the pickle bytes so
+                    # it surfaces as a deterministic UnpicklingError, never
+                    # a silently-wrong board: for a plain frame the pickle
+                    # IS the body (byte 0 = the PROTO opcode); for an
+                    # out-of-band frame the pickle sits after the subheader
+                    # — flipping a sidecar BUFFER byte would be exactly the
+                    # silent board corruption this proxy promises never to
+                    # produce
+                    if oob and length > _OOB_SUB.size:
+                        nbufs, pickle_len = _OOB_SUB.unpack_from(body, 0)
+                        p0 = _OOB_SUB.size + _OOB_LEN.size * nbufs
+                        p_end = min(p0 + pickle_len, length)
+                    else:
+                        p0, p_end = 0, length
+                    if p_end > p0:
+                        body[p0] ^= 0xFF  # the PROTO opcode
+                        if p_end - p0 > 1:
+                            rng = random.Random(self._seed ^ idx)
+                            for _ in range(3):
+                                body[rng.randrange(p0 + 1, p_end)] ^= 0xFF
                     payload = bytes(body)
                 dst.sendall(head + payload)
         except (OSError, ConnectionError):

@@ -38,6 +38,15 @@ class Methods:
     WORKER_UPDATE = "GameOfLifeOperations.Update"
     WORKER_QUIT = "GameOfLifeOperations.WorkerQuit"
     WORKER_STATUS = "GameOfLifeOperations.Status"
+    # extension: the resident-strip data plane (-wire resident). The strip
+    # STAYS on the worker across turns; only O(W)-sized halo rows move:
+    # StripStart seeds a strip at a turn, StripStep advances K turns given
+    # depth-K halo rows (per-step alive counts + fresh edge rows ride the
+    # reply), StripFetch reads the strip + its turn back out (full
+    # re-syncs, snapshots, loss recovery).
+    STRIP_START = "GameOfLifeOperations.StripStart"
+    STRIP_STEP = "GameOfLifeOperations.StripStep"
+    STRIP_FETCH = "GameOfLifeOperations.StripFetch"
 
 
 @dataclasses.dataclass
@@ -89,6 +98,15 @@ class Response:
     # the client can link its round-trip span to the handler-side span.
     # Same skew posture as Request.trace_ctx: getattr, absent = no trace.
     trace_ctx: Optional[dict] = None
+    # extensions for the resident-strip verbs (read via getattr — absent on
+    # a version-skewed peer's pickle): ``edges`` is the strip's boundary
+    # rows at its new turn, stacked [top K; bottom K] as one (2K, W) array
+    # (the broker relays them as the neighbours' next-batch halos, so only
+    # O(W·K) bytes move per batch); ``counts`` is the strip's per-step
+    # alive counts across the batch (the AliveCellsCount ticker's feed —
+    # no gather needed).
+    edges: Optional[np.ndarray] = None
+    counts: Optional[List] = None
 
 
 # -- deserialisation allowlist ----------------------------------------------
@@ -122,25 +140,83 @@ class _RestrictedUnpickler(pickle.Unpickler):
         )
 
 
-def loads_restricted(payload: bytes):
-    return _RestrictedUnpickler(io.BytesIO(payload)).load()
+def loads_restricted(payload: bytes, buffers=None):
+    """``buffers`` is the protocol-5 out-of-band sidecar list (in frame
+    order): each ndarray pickled out-of-band reconstructs as a VIEW of its
+    sidecar buffer (numpy's ``_frombuffer``), so the receive path pays no
+    parse-time copy. Same allowlist either way."""
+    return _RestrictedUnpickler(io.BytesIO(payload), buffers=buffers).load()
 
 
 # -- framing ----------------------------------------------------------------
+#
+# Two frame shapes share one 8-byte big-endian header word:
+#
+# * plain (the original wire, and the only shape an un-negotiated peer ever
+#   receives): header = payload length, payload = one pickle
+#   (protocol HIGHEST, ndarrays in-band).
+# * out-of-band (protocol 5): header = _FLAG_OOB | body length, body =
+#   [>IQ nbufs,pickle_len][>Q buf_len × nbufs][pickle][raw buffers...].
+#   Every ndarray ≥ _OOB_THRESHOLD travels as a raw sidecar buffer after
+#   the pickle: the sender hands the array's own memory to sendall (no
+#   serialize-time copy), the receiver reads each sidecar with recv_into
+#   into a preallocated buffer the unpickled array then WRAPS (no
+#   parse-time copy).
+#
+# Skew safety: MAX_FRAME < 2^34 keeps bit 63 free, so an OLD receiver that
+# is sent a flagged frame fails its length check loudly (connection drop,
+# never a mis-parse) — and the RPC layer only ever sends flagged frames to
+# peers that advertised support in their envelopes (rpc/client.py,
+# rpc/server.py), so old peers keep getting plain protocol-HIGHEST frames.
 
 _HEADER = struct.Struct(">Q")
 MAX_FRAME = 1 << 34  # 16 GiB: a 65536^2 board is ~4 GiB
+_FLAG_OOB = 1 << 63
+_LEN_MASK = _FLAG_OOB - 1
+_OOB_SUB = struct.Struct(">IQ")  # (nbufs, pickle_len)
+_OOB_LEN = struct.Struct(">Q")  # one sidecar buffer's length
+# below this, a buffer stays in-band: two syscalls + a subheader entry cost
+# more than memcpy'ing a few hundred bytes into the pickle
+_OOB_THRESHOLD = 1024
+# a frame may reference at most this many sidecars — a hostile subheader
+# must not make the receiver allocate an unbounded list
+_MAX_OOB_BUFFERS = 4096
 
 
-def send_frame(sock, obj) -> int:
+def send_frame(sock, obj, oob: bool = False) -> int:
     """Callers must serialise sends per-socket (both RpcClient and RpcServer
-    hold a write lock). Two sendalls avoid concatenating header+payload,
+    hold a write lock). Separate sendalls avoid concatenating header+payload,
     which would double peak memory on multi-GiB board frames. Returns the
-    frame size in bytes (header + payload) — the senders' byte meters."""
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(_HEADER.pack(len(payload)))
+    frame size in bytes (header + payload) — the senders' byte meters.
+
+    ``oob=True`` selects the protocol-5 out-of-band shape; the caller is
+    asserting the peer can parse it (the envelope negotiation in
+    rpc/client.py / rpc/server.py)."""
+    if not oob:
+        payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        sock.sendall(_HEADER.pack(len(payload)))
+        sock.sendall(payload)
+        return _HEADER.size + len(payload)
+    raws = []
+
+    def _sidecar(pb: pickle.PickleBuffer):
+        raw = pb.raw()
+        if raw.nbytes < _OOB_THRESHOLD:
+            return True  # truthy: pickle keeps it in-band
+        raws.append(raw)
+        return False  # falsy: out-of-band, we transport it below
+
+    payload = pickle.dumps(obj, protocol=5, buffer_callback=_sidecar)
+    sub = _OOB_SUB.pack(len(raws), len(payload)) + b"".join(
+        _OOB_LEN.pack(r.nbytes) for r in raws
+    )
+    total = len(sub) + len(payload) + sum(r.nbytes for r in raws)
+    sock.sendall(_HEADER.pack(_FLAG_OOB | total))
+    sock.sendall(sub)
     sock.sendall(payload)
-    return _HEADER.size + len(payload)
+    for raw in raws:
+        sock.sendall(raw)  # the array's own memory: zero-copy send
+    return _HEADER.size + total
 
 
 def _recv_exact(sock, n: int) -> bytes:
@@ -154,12 +230,47 @@ def _recv_exact(sock, n: int) -> bytes:
     return b"".join(chunks)
 
 
+def _recv_into_exact(sock, buf) -> None:
+    """Fill ``buf`` completely, straight off the socket — the sidecar
+    receive path: no intermediate bytes objects, no join, no copy."""
+    view = memoryview(buf)
+    got = 0
+    while got < len(view):
+        n = sock.recv_into(view[got:])
+        if not n:
+            raise ConnectionError("peer closed the connection")
+        got += n
+
+
 def recv_frame_sized(sock):
     """``(obj, frame_bytes)`` — the receivers' byte meters ride along."""
-    (length,) = _HEADER.unpack(_recv_exact(sock, _HEADER.size))
+    (word,) = _HEADER.unpack(_recv_exact(sock, _HEADER.size))
+    length = word & _LEN_MASK
     if length > MAX_FRAME:
         raise ConnectionError(f"frame of {length} bytes exceeds limit")
-    return loads_restricted(_recv_exact(sock, length)), _HEADER.size + length
+    if not word & _FLAG_OOB:
+        return loads_restricted(_recv_exact(sock, length)), _HEADER.size + length
+    # out-of-band shape: every subheader quantity is validated against the
+    # framed length BEFORE any allocation happens on its say-so
+    if length < _OOB_SUB.size:
+        raise ConnectionError("out-of-band frame shorter than its subheader")
+    nbufs, pickle_len = _OOB_SUB.unpack(_recv_exact(sock, _OOB_SUB.size))
+    if nbufs > _MAX_OOB_BUFFERS:
+        raise ConnectionError(f"frame claims {nbufs} sidecar buffers")
+    lens_blob = _recv_exact(sock, _OOB_LEN.size * nbufs)
+    buf_lens = [
+        _OOB_LEN.unpack_from(lens_blob, i * _OOB_LEN.size)[0]
+        for i in range(nbufs)
+    ]
+    if _OOB_SUB.size + _OOB_LEN.size * nbufs + pickle_len + sum(buf_lens) != length:
+        raise ConnectionError("out-of-band frame length mismatch")
+    payload = _recv_exact(sock, pickle_len)
+    buffers = []
+    for n in buf_lens:
+        buf = bytearray(n)
+        _recv_into_exact(sock, buf)
+        buffers.append(buf)
+    return loads_restricted(payload, buffers), _HEADER.size + length
 
 
 def recv_frame(sock):

@@ -66,6 +66,11 @@ class RpcServer:
 
     def _serve_conn(self, conn: socket.socket) -> None:
         write_lock = threading.Lock()
+        # per-connection protocol-5 capability: flips once the peer's
+        # envelope advertises it, after which replies may use out-of-band
+        # frames; an old client never advertises and keeps getting plain
+        # frames (the skew contract, rpc/protocol.py)
+        peer = {"oob": False}
         try:
             while True:
                 try:
@@ -78,13 +83,13 @@ class RpcServer:
                     return
                 threading.Thread(
                     target=self._dispatch,
-                    args=(conn, write_lock, msg, nbytes),
+                    args=(conn, write_lock, msg, nbytes, peer),
                     daemon=True,
                 ).start()
         finally:
             conn.close()
 
-    def _dispatch(self, conn, write_lock, msg, nbytes: int = 0) -> None:
+    def _dispatch(self, conn, write_lock, msg, nbytes: int = 0, peer=None) -> None:
         with self._inflight_cv:
             self._inflight += 1
         t0 = time.monotonic() if _metrics.enabled() else 0.0
@@ -97,6 +102,8 @@ class RpcServer:
             # identifiably waiting, RpcClient.call blocks without timeout),
             # a silent skip only when no id is recoverable
             envelope = msg if isinstance(msg, dict) else {}
+            if peer is not None and envelope.get("oob"):
+                peer["oob"] = True
             call_id = envelope.get("id")
             if call_id is None:
                 return  # not a call envelope: no reply is owed
@@ -161,8 +168,15 @@ class RpcServer:
             else:
                 _tracing.end_span(span)
             try:
+                # "oob": 1 in every reply envelope advertises protocol-5
+                # support to the CLIENT (old clients ignore unknown keys);
+                # the reply frame itself only upgrades once this peer
+                # advertised in a request envelope
+                reply["oob"] = 1
                 with write_lock:
-                    sent = send_frame(conn, reply)
+                    sent = send_frame(
+                        conn, reply, oob=bool(peer and peer["oob"])
+                    )
                 if _metrics.enabled():
                     _ins.RPC_SERVER_SENT_BYTES_TOTAL.labels(verb).inc(sent)
             except OSError:

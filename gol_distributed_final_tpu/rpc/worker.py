@@ -62,10 +62,46 @@ def compute_strip_haloed(padded: np.ndarray) -> np.ndarray:
     return _strip_step(padded)
 
 
+def strip_step_batch(
+    strip: np.ndarray, top: np.ndarray, bottom: np.ndarray, k: int
+) -> tuple[np.ndarray, list[int]]:
+    """Advance a resident strip K turns from depth-K halo rows, in
+    shrinking form: the (h + 2K)-row padded block loses one row per side
+    per step, landing exactly on the K-turns-later strip — the same
+    amortisation the mesh planes' wide halos use (parallel/halo.py), here
+    in the reference-shaped numpy kernel. Returns ``(next_strip,
+    per_step_alive_counts)``: the counts are of the STRIP's rows only, so
+    summing them across workers gives the whole board's count per turn
+    (the AliveCellsCount feed, no gather)."""
+    h = strip.shape[0]
+    if k < 1:
+        raise ValueError(f"strip batch needs k >= 1, got {k}")
+    if top.shape != (k, strip.shape[1]) or bottom.shape != (k, strip.shape[1]):
+        raise ValueError(
+            f"depth-{k} halos must each be ({k}, {strip.shape[1]}), got "
+            f"{top.shape} and {bottom.shape}"
+        )
+    padded = np.concatenate([top, strip, bottom], axis=0)
+    counts = []
+    for i in range(k):
+        padded = _strip_step(padded)  # 2 fewer rows per step
+        off = k - (i + 1)
+        counts.append(int(np.count_nonzero(padded[off : off + h])))
+    return padded, counts
+
+
 class WorkerService:
     def __init__(self, server: RpcServer):
         self._server = server
         self.quit_event = threading.Event()
+        # the resident-strip session (-wire resident): ONE strip per worker
+        # process, held across turns. (strip, turn, index) under a lock —
+        # StripStart replaces it wholesale, so a reseed after loss recovery
+        # can never leave a stale session behind.
+        self._strip_lock = threading.Lock()
+        self._strip: np.ndarray | None = None
+        self._strip_turn = 0
+        self._strip_index = 0
 
     def update(self, req: Request) -> Response:
         # chaos hook (rpc/faults.py): GOL_FAULT_POINTS can wedge, crash, or
@@ -80,6 +116,85 @@ class WorkerService:
             work_slice=compute_strip(world, req.start_y, req.end_y),
             worker=req.worker,
         )
+
+    # -- resident-strip verbs (-wire resident: the strip stays here) --------
+
+    def strip_start(self, req: Request) -> Response:
+        """Seed (or re-seed) this worker's resident strip at a turn. The
+        broker calls it at run start, after loss recovery, and at every
+        re-split — always with the full strip, so it REPLACES any previous
+        session unconditionally."""
+        strip = np.array(req.world, np.uint8, copy=True)  # own it: the
+        # request array may be a view of the receive buffer (protocol-5
+        # out-of-band), whose lifetime is the frame's, not the session's
+        if strip.ndim != 2 or strip.shape[0] < 1:
+            raise ValueError(f"strip must be a 2-D row block, got {strip.shape}")
+        with self._strip_lock:
+            self._strip = strip
+            self._strip_turn = getattr(req, "initial_turn", 0)
+            self._strip_index = req.worker
+        return Response(worker=req.worker, turns_completed=self._strip_turn)
+
+    def strip_step(self, req: Request) -> Response:
+        """Advance the resident strip ``req.turns`` turns given depth-K halo
+        rows (req.world = [top K; bottom K] stacked). Lockstep-guarded:
+        ``req.initial_turn`` must equal the strip's turn — a mismatch means
+        the broker and this worker disagree about history (a stale worker
+        readmitted mid-recovery) and MUST be an error reply, never a
+        silently-diverged strip."""
+        _faults.fault_point("worker.strip_step")
+        k = req.turns
+        with self._strip_lock:
+            if self._strip is None:
+                raise ValueError("no resident strip: StripStart must precede StripStep")
+            if getattr(req, "initial_turn", 0) != self._strip_turn:
+                raise ValueError(
+                    f"lockstep violation: strip is at turn {self._strip_turn}, "
+                    f"broker asked for turn {getattr(req, 'initial_turn', 0)}"
+                )
+            if req.worker != self._strip_index:
+                raise ValueError(
+                    f"strip index mismatch: seeded as {self._strip_index}, "
+                    f"stepped as {req.worker}"
+                )
+            halos = np.asarray(req.world, np.uint8)
+            if halos.shape != (2 * k, self._strip.shape[1]):
+                raise ValueError(
+                    f"depth-{k} halos must be ({2 * k}, "
+                    f"{self._strip.shape[1]}), got {halos.shape}"
+                )
+            if k > self._strip.shape[0]:
+                raise ValueError(
+                    f"batch depth {k} exceeds strip height {self._strip.shape[0]}"
+                )
+            strip, counts = strip_step_batch(self._strip, halos[:k], halos[k:], k)
+            self._strip = strip
+            self._strip_turn += k
+            # the fresh boundary rows: the broker relays them to this
+            # strip's neighbours as their next batch's halos — the only
+            # state that leaves this process per batch
+            edges = np.concatenate([strip[:k], strip[-k:]], axis=0)
+            return Response(
+                worker=req.worker,
+                turns_completed=self._strip_turn,
+                edges=edges,
+                counts=counts,
+            )
+
+    def strip_fetch(self, req: Request) -> Response:
+        """Read the resident strip + its turn back out (full re-syncs,
+        snapshots, loss recovery)."""
+        with self._strip_lock:
+            if self._strip is None:
+                raise ValueError("no resident strip to fetch")
+            # the reference itself is safe to ship: StripStep REPLACES the
+            # array (never mutates in place), so a concurrent step cannot
+            # change these bytes under the serialiser
+            return Response(
+                worker=self._strip_index,
+                turns_completed=self._strip_turn,
+                work_slice=self._strip,
+            )
 
     def worker_quit(self, req: Request) -> Response:
         # reply first, then shut the listener (worker/worker.go:82-86)
@@ -104,6 +219,9 @@ def serve(port: int = 8030, host: str = "127.0.0.1") -> tuple[RpcServer, WorkerS
     server.register(Methods.WORKER_UPDATE, service.update)
     server.register(Methods.WORKER_QUIT, service.worker_quit)
     server.register(Methods.WORKER_STATUS, service.status)
+    server.register(Methods.STRIP_START, service.strip_start)
+    server.register(Methods.STRIP_STEP, service.strip_step)
+    server.register(Methods.STRIP_FETCH, service.strip_fetch)
     server.serve_background()
     return server, service
 

@@ -1,0 +1,663 @@
+"""Wire data-plane suite: protocol-5 out-of-band frames + resident strips.
+
+Covers the two layers the `-wire resident` mode stands on:
+
+* ``rpc/protocol.py`` out-of-band framing — zero-copy send (the socket is
+  handed the array's own memory) and zero-copy receive (the unpickled
+  array wraps the receive buffer), plus old↔new frame-flag skew in both
+  directions (an un-negotiated peer only ever sees plain frames; a
+  flagged frame reaching an OLD receiver fails loudly, never mis-parses).
+* ``rpc/broker.py`` + ``rpc/worker.py`` resident sessions — oracle parity
+  against the tpu backend across geometries and batch depths, lockstep
+  enforcement, snapshot/pause sync boundaries, the per-step alive-count
+  feed, the wire-byte contract (resident ≥ 10× fewer bytes per turn than
+  haloed), and loss recovery.
+
+Fast in-process tests run in tier-1; the live multi-process chaos
+scenario is ``slow``-marked (``scripts/check --wire`` runs everything).
+"""
+
+import pickle
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gol_distributed_final_tpu.obs import metrics as obs_metrics
+from gol_distributed_final_tpu.rpc import protocol
+from gol_distributed_final_tpu.rpc import worker as rpc_worker
+from gol_distributed_final_tpu.rpc.broker import TpuBackend, WorkersBackend
+from gol_distributed_final_tpu.rpc.client import RemoteBroker, RpcClient
+from gol_distributed_final_tpu.rpc.protocol import (
+    MAX_FRAME,
+    Methods,
+    Request,
+    Response,
+    _FLAG_OOB,
+    _HEADER,
+    loads_restricted,
+    recv_frame_sized,
+    send_frame,
+)
+from gol_distributed_final_tpu.rpc.server import RpcServer
+
+from oracle import vector_step
+
+
+# -- protocol-5 out-of-band frames -------------------------------------------
+
+
+class _RecordingSock:
+    """Captures every sendall buffer — the zero-copy send assertion needs
+    the exact objects handed to the socket."""
+
+    def __init__(self):
+        self.chunks = []
+
+    def sendall(self, data):
+        self.chunks.append(data)
+
+
+def test_oob_send_is_zero_copy_and_small_arrays_stay_inband():
+    big = np.arange(64 * 64, dtype=np.uint8).reshape(64, 64)
+    small = np.arange(8, dtype=np.uint8)  # < _OOB_THRESHOLD: in-band
+    sock = _RecordingSock()
+    nbytes = send_frame(sock, {"big": big, "small": small}, oob=True)
+    assert nbytes == sum(
+        len(bytes(c)) if not isinstance(c, memoryview) else c.nbytes
+        for c in sock.chunks
+    ) + 0  # send_frame returns header + body, and we captured everything
+    # header word carries the flag
+    (word,) = _HEADER.unpack(bytes(sock.chunks[0]))
+    assert word & _FLAG_OOB
+    # exactly one sidecar (the big array): the subheader says so
+    nbufs, _pickle_len = protocol._OOB_SUB.unpack_from(bytes(sock.chunks[1]), 0)
+    assert nbufs == 1
+    # and the sidecar chunk IS the array's own memory — no serialize copy
+    sidecar = sock.chunks[-1]
+    assert isinstance(sidecar, memoryview)
+    assert np.shares_memory(np.frombuffer(sidecar, np.uint8), big)
+
+
+def test_oob_receive_reconstructs_views_of_the_sidecar_buffers():
+    arr = np.random.default_rng(0).integers(0, 255, (50, 60), dtype=np.uint8)
+    raws = []
+    payload = pickle.dumps(
+        {"x": arr}, protocol=5,
+        buffer_callback=lambda pb: raws.append(bytes(pb.raw())) and False,
+    )
+    buffers = [bytearray(r) for r in raws]
+    got = loads_restricted(payload, buffers)["x"]
+    assert np.array_equal(got, arr)
+    # zero parse-time copy: the array wraps the receive buffer
+    assert np.shares_memory(got, np.frombuffer(buffers[0], np.uint8))
+
+
+def test_oob_socket_roundtrip_request_response():
+    a, b = socket.socketpair()
+    try:
+        big = np.random.default_rng(1).integers(0, 255, (100, 100), np.uint8)
+        req = Request(world=big, turns=7, initial_turn=3)
+        sent = send_frame(a, {"id": 1, "request": req}, oob=True)
+        obj, nbytes = recv_frame_sized(b)
+        assert nbytes == sent
+        assert obj["id"] == 1
+        assert obj["request"].turns == 7
+        assert np.array_equal(obj["request"].world, big)
+        # the received array is a VIEW (its memory is the frame buffer),
+        # never an owning copy
+        assert obj["request"].world.base is not None
+    finally:
+        a.close()
+        b.close()
+
+
+def test_oob_frame_length_mismatch_is_a_loud_connection_error():
+    a, b = socket.socketpair()
+    try:
+        # subheader claims a pickle + sidecar that don't add up to the
+        # framed length: the receiver must refuse before allocating
+        sub = protocol._OOB_SUB.pack(1, 10) + protocol._OOB_LEN.pack(10)
+        body = sub + b"x" * 10  # 10 sidecar bytes missing
+        a.sendall(_HEADER.pack(_FLAG_OOB | len(body)))
+        a.sendall(body)
+        with pytest.raises(ConnectionError, match="length mismatch"):
+            recv_frame_sized(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def _old_recv_frame(sock):
+    """The PRE-out-of-band receiver, verbatim: 8-byte length header, one
+    plain pickle. The skew test sends it a flagged frame and the length
+    check must fail loudly (bit 63 rides above MAX_FRAME)."""
+    head = b""
+    while len(head) < 8:
+        chunk = sock.recv(8 - len(head))
+        if not chunk:
+            raise ConnectionError("peer closed the connection")
+        head += chunk
+    (length,) = struct.Struct(">Q").unpack(head)
+    if length > MAX_FRAME:
+        raise ConnectionError(f"frame of {length} bytes exceeds limit")
+    raise AssertionError("an old receiver must never parse a flagged frame")
+
+
+def test_flagged_frame_fails_an_old_receiver_loudly():
+    a, b = socket.socketpair()
+    try:
+        send_frame(a, {"x": np.zeros((64, 64), np.uint8)}, oob=True)
+        with pytest.raises(ConnectionError, match="exceeds limit"):
+            _old_recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_old_client_keeps_getting_plain_reply_frames():
+    """New-server-old-client skew: an envelope WITHOUT the "oob" key (an
+    old client's) must be answered with a PLAIN frame — the server only
+    upgrades a connection its peer advertised on."""
+    server = RpcServer(port=0)
+    server.register("T.Echo", lambda req: Response(world=np.asarray(req.world)))
+    server.serve_background()
+    sock = socket.create_connection(("127.0.0.1", server.port), timeout=5)
+    try:
+        big = np.arange(64 * 64, dtype=np.uint8).reshape(64, 64)
+        # old-client envelope: no "oob" key, plain frame
+        send_frame(sock, {"id": 0, "method": "T.Echo",
+                          "request": Request(world=big)})
+        head = b""
+        while len(head) < 8:
+            head += sock.recv(8 - len(head))
+        (word,) = _HEADER.unpack(head)
+        assert not word & _FLAG_OOB, "old client was sent a flagged frame"
+        body = b""
+        while len(body) < word:
+            body += sock.recv(min(1 << 20, word - len(body)))
+        reply = loads_restricted(body)
+        assert np.array_equal(reply["result"].world, big)
+        # the server DOES advertise, so a current client would upgrade
+        assert reply.get("oob") == 1
+    finally:
+        sock.close()
+        server.stop()
+
+
+def test_new_client_against_old_server_stays_plain():
+    """Old-server-new-client skew: a server whose replies lack the "oob"
+    key never receives a flagged frame, however many calls are made."""
+    listener = socket.socket()
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(1)
+    port = listener.getsockname()[1]
+    flagged = []
+
+    def old_server():
+        conn, _ = listener.accept()
+        with conn:
+            for _ in range(2):
+                head = b""
+                while len(head) < 8:
+                    head += conn.recv(8 - len(head))
+                (word,) = _HEADER.unpack(head)
+                flagged.append(bool(word & _FLAG_OOB))
+                length = word & (protocol._LEN_MASK if not word & _FLAG_OOB else (1 << 64) - 1)
+                body = b""
+                while len(body) < length:
+                    body += conn.recv(min(1 << 20, length - len(body)))
+                msg = loads_restricted(body)
+                # an OLD server's reply: no "oob" advertisement
+                send_frame(conn, {"id": msg["id"], "result": Response()})
+
+    t = threading.Thread(target=old_server, daemon=True)
+    t.start()
+    client = RpcClient(f"127.0.0.1:{port}", timeout=5)
+    try:
+        big = np.zeros((64, 64), np.uint8)
+        client.call("T.X", Request(world=big), timeout=5)
+        client.call("T.X", Request(world=big), timeout=5)
+        assert client._peer_oob is False
+        assert flagged == [False, False], "an old server saw a flagged frame"
+    finally:
+        client.close()
+        listener.close()
+        t.join(timeout=5)
+
+
+def test_rpc_negotiation_upgrades_and_roundtrips_big_arrays():
+    server = RpcServer(port=0)
+    server.register("T.Echo", lambda req: Response(world=np.asarray(req.world)))
+    server.serve_background()
+    client = RpcClient(f"127.0.0.1:{server.port}", timeout=5)
+    try:
+        big = np.random.default_rng(2).integers(0, 255, (128, 128), np.uint8)
+        assert client._peer_oob is False
+        r1 = client.call("T.Echo", Request(world=big), timeout=5)
+        assert np.array_equal(r1.world, big)
+        # the first reply advertised: this transport is upgraded now
+        assert client._peer_oob is True
+        r2 = client.call("T.Echo", Request(world=big), timeout=5)  # rides OOB
+        assert np.array_equal(r2.world, big)
+        assert r2.world.base is not None  # a view of the receive buffer
+    finally:
+        client.close()
+        server.stop()
+
+
+# -- resident strips: kernel + lockstep units --------------------------------
+
+
+def test_strip_step_batch_matches_oracle_shrinking_form():
+    rng = np.random.default_rng(5)
+    board = np.where(rng.random((20, 16)) < 0.4, 255, 0).astype(np.uint8)
+    k = 4
+    # strip = rows [8, 14) of the board; halos are the k rows around it
+    s, e = 8, 14
+    strip = board[s:e]
+    top = board[s - k:s]
+    bottom = board[e:e + k]
+    got, counts = rpc_worker.strip_step_batch(strip, top, bottom, k)
+    want = board.copy()
+    per_step = []
+    for _ in range(k):
+        want = vector_step(want)
+        per_step.append(int(np.count_nonzero(want[s:e])))
+    assert np.array_equal(got, want[s:e])
+    assert counts == per_step
+
+
+def test_worker_service_enforces_lockstep_and_session():
+    service = rpc_worker.WorkerService(server=None)
+    with pytest.raises(ValueError, match="StripStart must precede"):
+        service.strip_step(
+            Request(world=np.zeros((2, 8), np.uint8), turns=1, worker=0)
+        )
+    strip = np.zeros((4, 8), np.uint8)
+    service.strip_start(Request(world=strip, worker=1, initial_turn=10))
+    halos = np.zeros((2, 8), np.uint8)
+    with pytest.raises(ValueError, match="lockstep violation"):
+        service.strip_step(
+            Request(world=halos, turns=1, worker=1, initial_turn=9)
+        )
+    with pytest.raises(ValueError, match="index mismatch"):
+        service.strip_step(
+            Request(world=halos, turns=1, worker=2, initial_turn=10)
+        )
+    with pytest.raises(ValueError, match="exceeds strip height"):
+        service.strip_step(
+            Request(
+                world=np.zeros((10, 8), np.uint8), turns=5, worker=1,
+                initial_turn=10,
+            )
+        )
+    res = service.strip_step(
+        Request(world=halos, turns=1, worker=1, initial_turn=10)
+    )
+    assert res.turns_completed == 11
+    assert res.edges.shape == (2, 8)
+    fetched = service.strip_fetch(Request())
+    assert fetched.turns_completed == 11
+    # a re-seed REPLACES the session wholesale
+    service.strip_start(Request(world=strip, worker=1, initial_turn=0))
+    assert service.strip_fetch(Request()).turns_completed == 0
+
+
+# -- resident strips: in-process cluster -------------------------------------
+
+
+@pytest.fixture(scope="module")
+def wire_cluster():
+    """Four in-process workers (real RpcServers on loopback sockets)."""
+    servers = [rpc_worker.serve(port=0) for _ in range(4)]
+    yield [f"127.0.0.1:{s.port}" for s, _ in servers]
+    for server, _service in servers:
+        server.stop()
+
+
+def _rand_board(h, w, seed):
+    rng = np.random.default_rng(seed)
+    return np.where(rng.random((h, w)) < 0.4, 255, 0).astype(np.uint8)
+
+
+def _run_resident(addrs, board, turns, k, sync_interval=16, **kw):
+    backend = WorkersBackend(
+        addrs, wire="resident", halo_depth=k, sync_interval=sync_interval,
+        **kw,
+    )
+    try:
+        return backend.run(
+            Request(
+                world=board, turns=turns, threads=4,
+                image_width=board.shape[1], image_height=board.shape[0],
+            )
+        )
+    finally:
+        backend.close()
+
+
+_TPU_ORACLE_CACHE = {}
+
+
+def _tpu_backend_world(board, turns):
+    """The tpu backend's answer for the same Run — the parity oracle."""
+    key = (board.tobytes(), turns)
+    if key not in _TPU_ORACLE_CACHE:
+        res = TpuBackend().run(
+            Request(
+                world=board, turns=turns, threads=4,
+                image_width=board.shape[1], image_height=board.shape[0],
+            )
+        )
+        _TPU_ORACLE_CACHE[key] = np.asarray(res.world)
+    return _TPU_ORACLE_CACHE[key]
+
+
+@pytest.mark.parametrize("geometry", [(24, 33), (64, 64), (16, 40)])
+@pytest.mark.parametrize("k", [1, 4])
+def test_resident_parity_vs_tpu_backend(wire_cluster, geometry, k):
+    """Bit-identical to the tpu backend across geometries and batch
+    depths — uneven splits, partial final batches (41 % 4 != 0), and
+    periodic re-syncs included."""
+    h, w = geometry
+    board = _rand_board(h, w, seed=h * 100 + w)
+    turns = 41
+    res = _run_resident(wire_cluster, board, turns, k)
+    assert res.turns_completed == turns
+    np.testing.assert_array_equal(
+        res.world, _tpu_backend_world(board, turns)
+    )
+
+
+def test_resident_snapshot_pause_and_alive_ticker(wire_cluster):
+    """The snapshot path syncs on demand; pause parks on a synced board;
+    the count-only retrieve (the 2 s AliveCellsCount ticker) is served
+    from the per-step StripStep counts and is oracle-exact."""
+    board = _rand_board(48, 48, seed=9)
+    turns = 4000
+    backend = WorkersBackend(
+        wire_cluster, wire="resident", halo_depth=4, sync_interval=64
+    )
+    out = {}
+    t = threading.Thread(
+        target=lambda: out.update(
+            r=backend.run(
+                Request(
+                    world=board, turns=turns, threads=4,
+                    image_width=48, image_height=48,
+                )
+            )
+        )
+    )
+    t.start()
+    try:
+        deadline = time.monotonic() + 60
+        while backend.retrieve(include_world=False).turns_completed < 100:
+            assert time.monotonic() < deadline, "run never got going"
+            time.sleep(0.002)
+        # mid-run full snapshot: triggers one sync round, and the pair
+        # (world, turn) must be oracle-consistent
+        snap = backend.retrieve(include_world=True)
+        want = board.copy()
+        for _ in range(snap.turns_completed):
+            want = vector_step(want)
+        np.testing.assert_array_equal(snap.world, want)
+        # count-only: the shared _record_alive feed, no gather
+        tick = backend.retrieve(include_world=False)
+        want_t = want
+        for _ in range(tick.turns_completed - snap.turns_completed):
+            want_t = vector_step(want_t)
+        assert tick.alive_count == int(np.count_nonzero(want_t))
+        backend.pause()
+        a = backend.retrieve(include_world=True)
+        time.sleep(0.2)
+        b = backend.retrieve(include_world=False)
+        assert a.turns_completed == b.turns_completed, "advanced while parked"
+        # parked on a synced board: the snapshot is immediate and exact
+        want_p = board.copy()
+        for _ in range(a.turns_completed):
+            want_p = vector_step(want_p)
+        np.testing.assert_array_equal(a.world, want_p)
+        backend.pause()  # resume
+        t.join(timeout=120)
+        assert not t.is_alive()
+        want_final = board.copy()
+        for _ in range(turns):
+            want_final = vector_step(want_final)
+        np.testing.assert_array_equal(out["r"].world, want_final)
+    finally:
+        if t.is_alive():
+            backend.quit()
+            t.join(timeout=30)
+        backend.close()
+
+
+@pytest.fixture
+def live_metrics():
+    obs_metrics.enable()
+    obs_metrics.registry().reset()
+    yield obs_metrics
+    obs_metrics.enable(False)
+
+
+def _wire_totals():
+    out = {}
+    for fam in obs_metrics.registry().snapshot()["families"]:
+        if fam["name"] == "gol_wire_bytes_total":
+            out["bytes"] = sum(s["value"] for s in fam["series"])
+        if fam["name"] == "gol_turn_batch_size":
+            s = fam["series"][0] if fam["series"] else {}
+            out["batches"] = s.get("count", 0)
+            out["batched_turns"] = s.get("sum", 0.0)
+        if fam["name"] == "gol_strip_resync_total":
+            out["resyncs"] = sum(s["value"] for s in fam["series"])
+    return out
+
+
+def test_resident_wire_bytes_10x_below_haloed(wire_cluster, live_metrics):
+    """The acceptance contract, byte-accounted on loopback: resident K=8
+    moves >= 10x fewer frame bytes per turn than haloed, batches are
+    metered (gol_turn_batch_size), and sync_interval=0 costs exactly one
+    run-end resync."""
+    board = _rand_board(128, 128, seed=4)
+    turns = 80
+
+    b0 = _wire_totals().get("bytes", 0.0)
+    backend = WorkersBackend(wire_cluster, wire="haloed")
+    try:
+        r_hal = backend.run(
+            Request(world=board, turns=turns, threads=4,
+                    image_width=128, image_height=128)
+        )
+    finally:
+        backend.close()
+    s1 = _wire_totals()
+    haloed_per_turn = (s1["bytes"] - b0) / turns
+
+    res = _run_resident(wire_cluster, board, turns, k=8, sync_interval=0)
+    s2 = _wire_totals()
+    resident_per_turn = (s2["bytes"] - s1["bytes"]) / turns
+
+    np.testing.assert_array_equal(res.world, r_hal.world)  # same bits
+    assert resident_per_turn * 10 <= haloed_per_turn, (
+        f"resident {resident_per_turn:.0f} B/turn vs haloed "
+        f"{haloed_per_turn:.0f} B/turn"
+    )
+    assert s2["batches"] - s1["batches"] == turns / 8
+    assert s2["batched_turns"] - s1["batched_turns"] == turns
+    assert s2["resyncs"] - s1.get("resyncs", 0) == 1, (
+        "sync_interval=0 must sync only at run end"
+    )
+
+
+def test_resident_worker_loss_recovers_bit_identical():
+    """Kill one worker's server mid-run: the broker marks it lost,
+    rebuilds the board at the committed turn (survivor fetches + local
+    worker-kernel recompute from the last sync), reseeds over the
+    survivors, and the final board is bit-identical to the oracle."""
+    servers = [rpc_worker.serve(port=0) for _ in range(3)]
+    addrs = [f"127.0.0.1:{s.port}" for s, _ in servers]
+    board = _rand_board(48, 48, seed=11)
+    turns = 1500
+    backend = WorkersBackend(
+        addrs, wire="resident", halo_depth=4, sync_interval=64,
+        rpc_deadline=2.0, probe_interval=0.2,
+    )
+    out = {}
+    t = threading.Thread(
+        target=lambda: out.update(
+            r=backend.run(
+                Request(world=board, turns=turns, threads=3,
+                        image_width=48, image_height=48)
+            )
+        )
+    )
+    t.start()
+    try:
+        deadline = time.monotonic() + 60
+        while backend.retrieve(include_world=False).turns_completed < 150:
+            assert time.monotonic() < deadline, "run never got going"
+            time.sleep(0.002)
+        servers[1][0].stop()  # mid-batch loss
+        t.join(timeout=120)
+        assert not t.is_alive(), "run hung after the loss"
+        want = board.copy()
+        for _ in range(turns):
+            want = vector_step(want)
+        assert out["r"].turns_completed == turns
+        np.testing.assert_array_equal(out["r"].world, want)
+    finally:
+        if t.is_alive():
+            backend.quit()
+            t.join(timeout=30)
+        backend.close()
+        for server, _service in servers:
+            try:
+                server.stop()
+            except Exception:
+                pass
+
+
+def test_bench_diff_gates_wire_bytes_not_just_wall_clock():
+    """``scripts/bench_diff`` (obs/regress.py): a case whose
+    ``wire_bytes_per_turn`` grew past the threshold REGRESSES even when
+    its wall-clock is clean — byte accounting is deterministic, so no
+    noise band applies."""
+    from gol_distributed_final_tpu.obs.regress import compare_case
+
+    base = {
+        "per_turn_us": 100.0, "spread_s": 0.001, "n_lo": 100, "n_hi": 1100,
+        "wire_bytes_per_turn": 5000.0,
+    }
+    same = compare_case(base, dict(base))
+    assert same["verdict"] == "jitter"
+    assert same["bytes_delta_pct"] == 0.0
+    bloated = compare_case(base, dict(base, wire_bytes_per_turn=6000.0))
+    assert bloated["verdict"] == "REGRESSED"
+    assert "bytes" in bloated["why"]
+    slimmer = compare_case(base, dict(base, wire_bytes_per_turn=500.0))
+    assert slimmer["verdict"] == "jitter"  # a comms WIN never gates
+    # the byte gate survives a broken wall-clock fit (a salvaged round's
+    # zero/missing per_turn_us): deterministic comms growth still gates
+    broken = compare_case(
+        dict(base, per_turn_us=0.0), dict(base, wire_bytes_per_turn=6000.0)
+    )
+    assert broken["verdict"] == "REGRESSED"
+    assert "bytes" in broken["why"]
+    # cases without the meter (every non-wire config) are untouched
+    plain = compare_case(
+        {k: v for k, v in base.items() if k != "wire_bytes_per_turn"},
+        {k: v for k, v in base.items() if k != "wire_bytes_per_turn"},
+    )
+    assert "bytes_delta_pct" not in plain
+
+
+# -- live multi-process chaos (slow: scripts/check --wire) --------------------
+
+
+@pytest.mark.slow
+def test_resident_chaos_kill_worker_mid_batch_bit_identical(tmp_path):
+    """The live scenario: a subprocess cluster running ``-wire resident
+    -halo-depth 4``, one worker SIGKILLed mid-batch, restarted on its old
+    port, readmitted by the probe (the split re-expands) — and the
+    finished run is bit-identical to an uninterrupted oracle."""
+    from test_chaos import _kill_all, _oracle_64, _read_board_64
+    from test_rpc import _poll_turn, _spawn, _wait_listening
+
+    turns = 4000
+    workers = [
+        _spawn("gol_distributed_final_tpu.rpc.worker", "-port", "0")
+        for _ in range(3)
+    ]
+    broker = restarted = None
+    try:
+        ports = [_wait_listening(w) for w in workers]
+        broker = _spawn(
+            "gol_distributed_final_tpu.rpc.broker",
+            "-port", "0", "-backend", "workers", "-metrics",
+            "-wire", "resident", "-halo-depth", "4", "-sync-interval", "32",
+            "-workers", ",".join(f"127.0.0.1:{p}" for p in ports),
+            "-rpc-deadline", "5", "-probe-interval", "0.2",
+        )
+        address = f"127.0.0.1:{_wait_listening(broker)}"
+        from gol_distributed_final_tpu import Params
+
+        p = Params(turns=turns, threads=3, image_width=64, image_height=64)
+        board = _read_board_64()
+        remote = RemoteBroker(address, timeout=30.0)
+        result = {}
+        t = threading.Thread(
+            target=lambda: result.update(r=remote.run(p, board))
+        )
+        t.start()
+        try:
+            _poll_turn(remote, 300)
+            workers[1].kill()  # SIGKILL mid-batch
+            workers[1].wait()
+            # restart on the old port: the roster address heals and the
+            # probe readmits it; the resident split must RE-EXPAND
+            restarted = _spawn(
+                "gol_distributed_final_tpu.rpc.worker",
+                "-port", str(ports[1]), "-metrics",
+            )
+            _wait_listening(restarted)
+            from test_chaos import _fetch_broker_counter
+
+            deadline = time.monotonic() + 30
+            while (
+                _fetch_broker_counter(address, "gol_worker_readmitted_total")
+                < 1
+            ):
+                assert time.monotonic() < deadline, "never readmitted"
+                time.sleep(0.2)
+            t.join(timeout=300)
+            assert not t.is_alive(), "run did not complete after readmission"
+        finally:
+            if t.is_alive():
+                remote.quit()
+                t.join(timeout=30)
+            remote.close()
+        r = result["r"]
+        assert r.turns_completed == turns
+        np.testing.assert_array_equal(r.world, _oracle_64(turns))
+        # the readmitted worker held a strip again: it served StripStep
+        from gol_distributed_final_tpu.obs.status import fetch_status
+
+        wpayload = fetch_status(
+            f"127.0.0.1:{ports[1]}", worker=True, timeout=5.0
+        )
+        steps = 0.0
+        for fam in (wpayload.get("metrics") or {}).get("families", []):
+            if fam["name"] == "gol_rpc_server_requests_total":
+                steps = sum(
+                    s["value"]
+                    for s in fam["series"]
+                    if Methods.STRIP_STEP in tuple(s["labels"])
+                )
+        assert steps > 0, "restarted worker never held a resident strip"
+    finally:
+        _kill_all([*workers, broker, restarted])
