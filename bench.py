@@ -210,6 +210,7 @@ def _bench_wire_modes(extra: dict) -> int:
     import numpy as np
 
     from gol_distributed_final_tpu.obs import metrics as obs_metrics
+    from gol_distributed_final_tpu.rpc import integrity as _integrity
     from gol_distributed_final_tpu.rpc import worker as rpc_worker
     from gol_distributed_final_tpu.rpc.broker import WorkersBackend
     from gol_distributed_final_tpu.rpc.protocol import Request
@@ -227,14 +228,20 @@ def _bench_wire_modes(extra: dict) -> int:
     board = np.where(rng.random((size, size)) < 0.3, 255, 0).astype(np.uint8)
     want100 = None  # cross-mode parity reference (100 turns)
     try:
-        for wire, k, key, n_lo, n_hi in (
-            ("full", 1, "c7_wire_full", 30, 230),
-            ("haloed", 1, "c7_wire_haloed", 30, 230),
+        for wire, k, key, n_lo, n_hi, check in (
+            ("full", 1, "c7_wire_full", 30, 230, True),
+            ("haloed", 1, "c7_wire_haloed", 30, 230, True),
             # resident turns are much cheaper per RPC: wider endpoints so
             # the marginal work still dominates loopback timing noise
-            ("resident", 1, "c7_wire_resident_k1", 100, 1100),
-            ("resident", 8, "c7_wire_resident_k8", 100, 1100),
+            ("resident", 1, "c7_wire_resident_k1", 100, 1100, True),
+            ("resident", 8, "c7_wire_resident_k8", 100, 1100, True),
+            # the same case UNDEFENDED (-integrity off, both sides): the
+            # checked case above pays the in-header frame crcs + adler32
+            # attestations, so the pair prices the integrity layer — the
+            # overhead gate below holds it under 3% of resident turn cost
+            ("resident", 8, "c7_wire_resident_k8_nock", 100, 1100, False),
         ):
+            _integrity.set_enabled(check)
             backend = WorkersBackend(addrs, wire=wire, halo_depth=k)
             try:
                 def evolve(n, backend=backend):
@@ -283,7 +290,37 @@ def _bench_wire_modes(extra: dict) -> int:
             f"{hal:.0f} B/turn ({hal / res8:.0f}x fewer)",
             file=sys.stderr,
         )
+        # integrity overhead gate: checked vs unchecked resident K=8. Byte
+        # accounting is deterministic; wall-clock is not, so the 3% bound
+        # gets each fit's own noise band on top (the obs/regress posture) —
+        # a loopback scheduling hiccup must not fail the bench, a real
+        # hashing-cost regression must. The embedded overhead_pct rides
+        # into BENCH_r*.json so bench_diff gates the trajectory too.
+        ck, nock = extra["c7_wire_resident_k8"], extra["c7_wire_resident_k8_nock"]
+        pt_ck = ck["per_turn_us"]
+        pt_no = nock["per_turn_us"]
+        noise_us = sum(
+            c["spread_s"] / (c["n_hi"] - c["n_lo"]) * 1e6 for c in (ck, nock)
+        )
+        overhead_pct = (pt_ck - pt_no) / pt_no * 100.0
+        ck["integrity_overhead_pct"] = round(overhead_pct, 2)
+        if pt_ck - pt_no > 0.03 * pt_no + 2 * noise_us:
+            print(
+                f"INTEGRITY OVERHEAD GATE FAILURE: checked resident k8 "
+                f"{pt_ck:.2f} us/turn vs unchecked {pt_no:.2f} "
+                f"({overhead_pct:+.1f}%) exceeds 3% beyond the "
+                f"{noise_us:.2f} us noise band",
+                file=sys.stderr,
+            )
+            return 1
+        print(
+            f"integrity overhead ok: checked {pt_ck:.2f} us/turn vs "
+            f"unchecked {pt_no:.2f} ({overhead_pct:+.1f}%, band "
+            f"{2 * noise_us:.2f} us)",
+            file=sys.stderr,
+        )
     finally:
+        _integrity.set_enabled(True)
         for server, _service in servers:
             server.stop()
     return 0

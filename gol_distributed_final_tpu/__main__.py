@@ -157,6 +157,20 @@ def main(argv=None) -> int:
             rule = LifeRule.from_rulestring(args.rule)
         except ValueError as e:
             parser.error(str(e))
+    resume = None
+    if args.resume:
+        # same posture for the checkpoint: verify it NOW (typed, actionable
+        # refusal — engine/checkpoint.py) instead of a mid-setup traceback
+        # with the event consumer already running. The verified result is
+        # passed through to run() so the file is read and hashed exactly
+        # once — a second load could even see a different file after an
+        # auto-checkpoint rotation.
+        from .engine.checkpoint import CheckpointError, load_verified_checkpoint
+
+        try:
+            resume = load_verified_checkpoint(args.resume)
+        except CheckpointError as e:
+            parser.error(f"-resume {args.resume}: {e}")
 
     from . import Params, run
 
@@ -204,7 +218,7 @@ def main(argv=None) -> int:
             trace_ctx = device_trace(args.trace_device)
         with trace_ctx:
             run(params, events, keypresses, broker=broker, rule=rule,
-                emit_flips=emit_flips, resume_from=args.resume,
+                emit_flips=emit_flips, resume_from=resume,
                 halo_depth=args.halo_depth, report=args.report)
     finally:
         consumer.join()

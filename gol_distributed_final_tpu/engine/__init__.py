@@ -1,17 +1,23 @@
 from .checkpoint import (
+    CheckpointError,
     load_checkpoint,
     load_packed_checkpoint,
+    load_resume_checkpoint,
+    load_verified_checkpoint,
     save_checkpoint,
     save_packed_checkpoint,
 )
 from .engine import Engine, RunResult, Snapshot
 
 __all__ = [
+    "CheckpointError",
     "Engine",
     "RunResult",
     "Snapshot",
     "load_checkpoint",
     "load_packed_checkpoint",
+    "load_resume_checkpoint",
+    "load_verified_checkpoint",
     "save_checkpoint",
     "save_packed_checkpoint",
 ]

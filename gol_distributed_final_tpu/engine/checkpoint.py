@@ -10,16 +10,63 @@ an uninterrupted run (tests/test_checkpoint.py).
 Resume ≡ uninterrupted run is proven bit-identical by
 tests/test_aux.py::test_resume_equals_uninterrupted_run.
 
-Format: a plain .npz — board (uint8 [H, W]), turn (int), rulestring (str).
+Format: a plain .npz — board (uint8 [H, W]), turn (int), rulestring (str),
+plus (format v2) an embedded blake2b digest over (geometry, turn, rule,
+board bytes) and a format-version stamp, so a truncated, corrupt, or
+mislabelled file is a LOUD typed :class:`CheckpointError` at load time
+instead of a silently-wrong resume. ``-resume`` surfaces only go through
+:func:`load_verified_checkpoint` / :func:`load_resume_checkpoint` (the
+latter falls back across ``-ckpt-keep`` generations to the newest file
+that verifies); the plain :func:`load_checkpoint` stays lenient for
+callers that accept pre-integrity files.
 """
 
 from __future__ import annotations
 
+import hashlib
 import pathlib
 
 import numpy as np
 
 from ..models import CONWAY, LifeRule
+from ..obs import instruments as _ins
+
+CKPT_FORMAT_VERSION = 2
+
+
+class CheckpointError(ValueError):
+    """A checkpoint that must not be resumed from. ``kind`` narrows the
+    failure: ``unreadable`` (not an npz / truncated zip), ``truncated``
+    (an npz missing checkpoint fields), ``format`` (a packed-bitboard
+    file on the byte surface), ``unverified`` (a pre-integrity file with
+    no embedded digest), ``digest`` (contents do not hash to the embedded
+    digest), ``exhausted`` (every ``-ckpt-keep`` generation failed). The
+    message always says what to do next."""
+
+    def __init__(self, message: str, kind: str = "corrupt"):
+        super().__init__(message)
+        self.kind = kind
+
+
+def checkpoint_digest(
+    board, turn: int, rulestring: str,
+    format_version: int = CKPT_FORMAT_VERSION,
+) -> str:
+    """blake2b-128 hex digest binding the board BYTES to its metadata —
+    geometry, turn, and rule — so a bit flip in any of them (or a
+    board/metadata swap between files) fails verification.
+
+    ``format_version`` is the version stamped IN the file being written
+    or verified, not this module's constant: a version bump must not
+    retroactively flip every existing valid file to kind="digest"."""
+    board = np.ascontiguousarray(board, np.uint8)
+    h = hashlib.blake2b(digest_size=16)
+    h.update(
+        f"gol-ckpt:v{int(format_version)}:{board.shape[0]}x{board.shape[1]}"
+        f":{int(turn)}:{rulestring}:".encode()
+    )
+    h.update(board.data)
+    return h.hexdigest()
 
 
 def npz_path(path) -> pathlib.Path:
@@ -39,11 +86,16 @@ def _save_npz(path, **arrays) -> pathlib.Path:
 
 
 def save_checkpoint(path, world, turn: int, rule: LifeRule = CONWAY) -> pathlib.Path:
+    board = np.asarray(world, np.uint8)
     return _save_npz(
         path,
-        board=np.asarray(world, np.uint8),
+        board=board,
         turn=np.int64(turn),
         rulestring=np.str_(rule.rulestring),
+        # format v2: the verification surface (load_verified_checkpoint).
+        # Older loaders ignore the extra keys — forward-compatible.
+        format_version=np.int64(CKPT_FORMAT_VERSION),
+        digest=np.str_(checkpoint_digest(board, turn, rule.rulestring)),
     )
 
 
@@ -59,6 +111,152 @@ def load_checkpoint(path) -> tuple[np.ndarray, int, LifeRule]:
         turn = int(data["turn"])
         rule = LifeRule.from_rulestring(str(data["rulestring"]))
     return board, turn, rule
+
+
+def _load_for_verification(
+    path,
+) -> tuple[np.ndarray, int, LifeRule, str | None, int]:
+    """The typed-error load: every way an npz can be wrong becomes a
+    CheckpointError whose message says what happened and what to do —
+    never a raw zipfile/KeyError/ValueError traceback."""
+    path = pathlib.Path(path)
+    try:
+        with np.load(path, allow_pickle=False) as data:
+            if "packed" in data:
+                raise CheckpointError(
+                    f"{path} is a packed-bitboard checkpoint; the -resume "
+                    "surface takes byte checkpoints (the bigboard surface "
+                    "loads packed ones)",
+                    kind="format",
+                )
+            missing = [
+                k for k in ("board", "turn", "rulestring") if k not in data
+            ]
+            if missing:
+                raise CheckpointError(
+                    f"{path} is missing checkpoint field(s) "
+                    f"{', '.join(missing)}: not a checkpoint, or one cut "
+                    "short mid-write — fall back to an older generation "
+                    "(-ckpt-keep) or start the run fresh",
+                    kind="truncated",
+                )
+            board = data["board"].astype(np.uint8)
+            turn = int(data["turn"])
+            rulestring = str(data["rulestring"])
+            stored = str(data["digest"]) if "digest" in data else None
+            # the version the FILE claims; digests began at v2, so a
+            # digested file without the stamp verifies as v2
+            version = (
+                int(data["format_version"])
+                if "format_version" in data else CKPT_FORMAT_VERSION
+            )
+    except CheckpointError:
+        raise
+    except Exception as exc:
+        raise CheckpointError(
+            f"{path} is not a readable checkpoint "
+            f"({type(exc).__name__}: {exc}): the file is truncated or "
+            "corrupt — fall back to an older generation (-ckpt-keep) or "
+            "start the run fresh",
+            kind="unreadable",
+        ) from exc
+    try:
+        rule = LifeRule.from_rulestring(rulestring)
+    except ValueError as exc:
+        raise CheckpointError(
+            f"{path} carries an unparseable rulestring "
+            f"{rulestring!r}: {exc}", kind="truncated",
+        ) from exc
+    return board, turn, rule, stored, version
+
+
+def load_verified_checkpoint(path) -> tuple[np.ndarray, int, LifeRule]:
+    """``load_checkpoint`` with the integrity contract: the file must
+    carry a digest and its contents must hash to it. Raises a typed,
+    actionable :class:`CheckpointError` otherwise — a resume must never
+    reattach state it cannot verify. Every attempt is counted
+    (``gol_ckpt_verify_total{result}``)."""
+    try:
+        board, turn, rule, stored, version = _load_for_verification(path)
+        if stored is None:
+            raise CheckpointError(
+                f"{path} carries no integrity digest (written by a "
+                "pre-integrity version): -resume refuses unverified "
+                "state; load it explicitly with load_checkpoint() if you "
+                "accept the risk",
+                kind="unverified",
+            )
+        if version > CKPT_FORMAT_VERSION:
+            raise CheckpointError(
+                f"{path} is a format-v{version} checkpoint but this "
+                f"build verifies up to v{CKPT_FORMAT_VERSION}: load it "
+                "with the version that wrote it",
+                kind="format",
+            )
+        # verify against the version the FILE was written under — the
+        # digest preimage is versioned with the file, not this build
+        if checkpoint_digest(board, turn, rule.rulestring, version) != stored:
+            raise CheckpointError(
+                f"{path} failed digest verification: board/turn/rule do "
+                "not hash to the embedded digest — the file is corrupt; "
+                "fall back to an older generation (-ckpt-keep)",
+                kind="digest",
+            )
+    except CheckpointError:
+        _ins.CKPT_VERIFY_TOTAL.labels("fail").inc()
+        raise
+    _ins.CKPT_VERIFY_TOTAL.labels("ok").inc()
+    return board, turn, rule
+
+
+def generation_path(path, gen: int) -> pathlib.Path:
+    """Where generation ``gen`` of a rotated checkpoint lives: gen 0 is
+    the configured path itself, gen N is ``<stem>.gN.npz`` beside it
+    (newest-first numbering — g1 is the previous current)."""
+    p = npz_path(path)
+    return p if gen == 0 else p.with_name(f"{p.stem}.g{gen}.npz")
+
+
+def rotate_generations(path, keep: int) -> None:
+    """Shift the generation chain down one slot before a new current is
+    written: current → .g1 → .g2 → …, keeping at most ``keep``
+    generations total. Best-effort renames: a missing link just shortens
+    the chain, it never blocks the new checkpoint."""
+    if keep <= 1:
+        return
+    for gen in range(keep - 2, -1, -1):
+        src = generation_path(path, gen)
+        if src.exists():
+            src.replace(generation_path(path, gen + 1))
+
+
+def load_resume_checkpoint(path, keep: int = 1) -> tuple[np.ndarray, int, LifeRule, int]:
+    """The ``-resume`` loader: newest VERIFIABLE generation of ``path``
+    → ``(board, turn, rule, generation)``. Tries gen 0 … keep-1 in order
+    and falls back past unverifiable files; raises a CheckpointError
+    listing every attempt when none verifies — resuming from nothing must
+    be an operator decision, never a silent from-zero run."""
+    attempts = []
+    for gen in range(max(1, keep)):
+        p = generation_path(path, gen)
+        if not p.exists():
+            raw = pathlib.Path(path)
+            if gen == 0 and raw.exists():
+                p = raw  # an explicit non-.npz-suffixed path
+            else:
+                attempts.append(f"{p}: not found")
+                continue
+        try:
+            board, turn, rule = load_verified_checkpoint(p)
+        except CheckpointError as exc:
+            attempts.append(f"{p}: [{exc.kind}] {exc}")
+            continue
+        return board, turn, rule, gen
+    raise CheckpointError(
+        "no verifiable checkpoint generation to resume from:\n  "
+        + "\n  ".join(attempts),
+        kind="exhausted",
+    )
 
 
 def save_packed_checkpoint(

@@ -338,7 +338,12 @@ def run(
 
     ``resume_from`` continues from a checkpoint (engine/checkpoint.py)
     instead of loading images/<W>x<H>.pgm at turn 0 — the capability the
-    reference lacks (SURVEY.md §5 checkpoint/resume).
+    reference lacks (SURVEY.md §5 checkpoint/resume). Either a path (the
+    file is verified-or-refused here) or an already-verified
+    ``(board, turn, rule)`` tuple as returned by
+    ``load_verified_checkpoint`` — callers that verify early (the
+    ``-resume`` CLI) pass the result through so the file is read and
+    hashed once.
 
     ``halo_depth`` (0 = backend default) ships the wide-halo depth to a
     remote broker — the tpu backend's mesh planes, or the workers
@@ -362,9 +367,18 @@ def run(
     initial_turn = 0
     ckpt_rule = None
     if resume_from is not None:
-        from .checkpoint import load_checkpoint
+        if isinstance(resume_from, tuple):
+            ckpt_world, initial_turn, ckpt_rule = resume_from
+        else:
+            # verified-or-refused (engine/checkpoint.py): a truncated,
+            # corrupt, or digest-less file is a typed, actionable
+            # CheckpointError here — never a raw zipfile/KeyError
+            # traceback, and never a silently resumed wrong board
+            from .checkpoint import load_verified_checkpoint
 
-        ckpt_world, initial_turn, ckpt_rule = load_checkpoint(resume_from)
+            ckpt_world, initial_turn, ckpt_rule = load_verified_checkpoint(
+                resume_from
+            )
         if ckpt_world.shape != (params.image_height, params.image_width):
             raise ValueError(
                 f"checkpoint board is {ckpt_world.shape[1]}x"

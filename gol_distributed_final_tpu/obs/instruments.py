@@ -153,6 +153,35 @@ STRIP_RESYNC_TOTAL = _R.counter(
     "recovery.",
 )
 
+# -- data integrity (rpc/integrity.py: checked frames, attestation,
+#    verified checkpoints) ---------------------------------------------------
+
+INTEGRITY_CHECKS_TOTAL = _R.counter(
+    "gol_integrity_checks_total",
+    "Integrity verifications performed: in-header frame crc words verified, "
+    "resident-strip digest-chain / edge-digest / halo cross-attestation "
+    "comparisons on the broker.",
+)
+INTEGRITY_FAILURES_TOTAL = _R.counter(
+    "gol_integrity_failures_total",
+    "Integrity verifications that FAILED, by kind: 'frame' (checksum "
+    "mismatch — the frame was never parsed), 'strip' (a resident strip's "
+    "pre-batch digest broke the committed chain: in-place corruption), "
+    "'edges' (reply edge rows disagree with their attested digest), "
+    "'attest' (neighbouring strips' redundant boundary-band digests "
+    "disagree: wrong compute), 'fetch' (a gathered strip does not hash to "
+    "the committed chain). Every failure routes the suspect through the "
+    "loss/quarantine machinery.",
+    labelnames=("kind",),
+)
+CKPT_VERIFY_TOTAL = _R.counter(
+    "gol_ckpt_verify_total",
+    "Checkpoint digest verifications (engine/checkpoint.py "
+    "load_verified_checkpoint), by result (ok/fail) — every -resume "
+    "attempt and -ckpt-keep fallback probe counts here.",
+    labelnames=("result",),
+)
+
 # -- fault tolerance (rpc/client.py reconnect, rpc/broker.py recovery) ------
 
 RPC_RETRIES_TOTAL = _R.counter(

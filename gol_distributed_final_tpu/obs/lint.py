@@ -69,6 +69,7 @@ _REQUIRED_SECTIONS = (
     "Perf regression gate",
     "Fault tolerance",
     "Wire modes",
+    "Integrity",
 )
 
 # the wire data-plane metric families (rpc/protocol.py frames + the
@@ -80,6 +81,23 @@ _WIRE_METRIC_NAMES = (
 )
 
 
+def _readme_section(readme_path, anchor: str) -> str:
+    """The README text from ``anchor`` to the next top-level heading —
+    the section-scoped lint surface: a name that only appears in a LATER
+    section must still be flagged. Anchor on the heading itself (e.g.
+    ``"## Wire modes"``) when cross-references elsewhere in the file
+    could shadow the real section. Missing anchor -> empty section, so
+    every required name is reported rather than silently passed."""
+    if readme_path is None:
+        readme_path = REPO_ROOT / "README.md"
+    text = pathlib.Path(readme_path).read_text()
+    start = text.find(anchor)
+    if start < 0:
+        return ""
+    end = text.find("\n## ", start)
+    return text[start:] if end < 0 else text[start:end]
+
+
 def undocumented_device_metrics(readme_path=None) -> List[str]:
     """Device-telemetry metric names (obs/device.py's families) missing
     from the README's "Device telemetry" section specifically — a name
@@ -87,17 +105,7 @@ def undocumented_device_metrics(readme_path=None) -> List[str]:
     from . import instruments  # noqa: F401 - registers every family
     from .metrics import registry
 
-    if readme_path is None:
-        readme_path = REPO_ROOT / "README.md"
-    text = pathlib.Path(readme_path).read_text()
-    anchor = text.find("Device telemetry")
-    if anchor >= 0:
-        # bound the section at the next top-level heading: a name that
-        # only appears in a LATER section must still be flagged
-        end = text.find("\n## ", anchor)
-        section = text[anchor:] if end < 0 else text[anchor:end]
-    else:
-        section = ""
+    section = _readme_section(readme_path, "Device telemetry")
     return sorted(
         fam.name
         for fam in registry().families()
@@ -106,21 +114,30 @@ def undocumented_device_metrics(readme_path=None) -> List[str]:
     )
 
 
+# the integrity metric families (rpc/integrity.py: checked frames,
+# resident-strip attestation, verified checkpoints): these must be
+# documented in the README's "Integrity" section specifically — the
+# operator contract for the silent-corruption detection surface
+_INTEGRITY_METRIC_NAMES = (
+    "gol_integrity_checks_total",
+    "gol_integrity_failures_total",
+    "gol_ckpt_verify_total",
+)
+
+
+def undocumented_integrity_metrics(readme_path=None) -> List[str]:
+    """Integrity metric names missing from the README's "Integrity"
+    section specifically (the wire/device-table posture: a name mentioned
+    elsewhere in the file does not count as documented here)."""
+    section = _readme_section(readme_path, "## Integrity")
+    return sorted(n for n in _INTEGRITY_METRIC_NAMES if n not in section)
+
+
 def undocumented_wire_metrics(readme_path=None) -> List[str]:
     """Wire data-plane metric names missing from the README's
     "Wire modes" section specifically (the device-table posture: a name
     mentioned elsewhere in the file does not count as documented here)."""
-    if readme_path is None:
-        readme_path = REPO_ROOT / "README.md"
-    text = pathlib.Path(readme_path).read_text()
-    # anchor on the HEADING: cross-references ("see **Wire modes**")
-    # elsewhere in the file must not shadow the real section
-    anchor = text.find("## Wire modes")
-    if anchor >= 0:
-        end = text.find("\n## ", anchor)
-        section = text[anchor:] if end < 0 else text[anchor:end]
-    else:
-        section = ""
+    section = _readme_section(readme_path, "## Wire modes")
     return sorted(n for n in _WIRE_METRIC_NAMES if n not in section)
 
 
@@ -133,71 +150,56 @@ def missing_readme_sections(readme_path=None) -> List[str]:
 
 
 def main(argv=None) -> int:
-    rc = 0
-    missing = undocumented_metrics()
-    if missing:
-        print(
+    checks = (
+        (
+            undocumented_metrics,
             "metrics registered in obs/instruments.py but missing from "
             "README.md's Observability table:",
-            file=sys.stderr,
-        )
-        for name in missing:
-            print(f"  {name}", file=sys.stderr)
-        rc = 1
-    else:
-        print("metric-name lint ok: every registered metric is documented")
-    missing_spans = undocumented_spans()
-    if missing_spans:
-        print(
+            "metric-name lint ok: every registered metric is documented",
+        ),
+        (
+            undocumented_spans,
             "span names declared in obs/tracing.py but missing from "
             "README.md's Tracing table:",
-            file=sys.stderr,
-        )
-        for name in missing_spans:
-            print(f"  {name}", file=sys.stderr)
-        rc = 1
-    else:
-        print("span-name lint ok: every declared span name is documented")
-    missing_dev = undocumented_device_metrics()
-    if missing_dev:
-        print(
+            "span-name lint ok: every declared span name is documented",
+        ),
+        (
+            undocumented_device_metrics,
             "device metrics registered in obs/instruments.py but missing "
             "from README.md's Device telemetry table:",
-            file=sys.stderr,
-        )
-        for name in missing_dev:
-            print(f"  {name}", file=sys.stderr)
-        rc = 1
-    else:
-        print(
             "device-metric lint ok: every device metric is in the Device "
-            "telemetry table"
-        )
-    missing_wire = undocumented_wire_metrics()
-    if missing_wire:
-        print(
+            "telemetry table",
+        ),
+        (
+            undocumented_wire_metrics,
             "wire data-plane metrics missing from README.md's Wire modes "
             "section:",
-            file=sys.stderr,
-        )
-        for name in missing_wire:
-            print(f"  {name}", file=sys.stderr)
-        rc = 1
-    else:
-        print(
             "wire-metric lint ok: every wire metric is in the Wire modes "
-            "section"
-        )
-    missing_sections = missing_readme_sections()
-    if missing_sections:
-        print(
-            "required README sections missing:", file=sys.stderr,
-        )
-        for section in missing_sections:
-            print(f"  {section}", file=sys.stderr)
-        rc = 1
-    else:
-        print("section lint ok: every required README section present")
+            "section",
+        ),
+        (
+            undocumented_integrity_metrics,
+            "integrity metrics missing from README.md's Integrity "
+            "section:",
+            "integrity-metric lint ok: every integrity metric is in the "
+            "Integrity section",
+        ),
+        (
+            missing_readme_sections,
+            "required README sections missing:",
+            "section lint ok: every required README section present",
+        ),
+    )
+    rc = 0
+    for check, fail_msg, ok_msg in checks:
+        missing = check()
+        if missing:
+            print(fail_msg, file=sys.stderr)
+            for name in missing:
+                print(f"  {name}", file=sys.stderr)
+            rc = 1
+        else:
+            print(ok_msg)
     return rc
 
 

@@ -183,6 +183,39 @@ def _worker_lines(payload: dict) -> List[str]:
     return out
 
 
+def _integrity_lines(snap: dict) -> List[str]:
+    """The silent-corruption column (rpc/integrity.py): verifications
+    performed, failures broken out by kind (frame / strip / edges /
+    attest / fetch — each one is a corruption that was CAUGHT), and
+    checkpoint digest verifications by result. All-zero registries render
+    nothing; a nonzero failure line is the headline an operator attaches
+    this dashboard for."""
+    checks = _scalar(snap, "gol_integrity_checks_total")
+    fails = _series_map(snap, "gol_integrity_failures_total")
+    ckpt = _series_map(snap, "gol_ckpt_verify_total")
+    total_fail = sum(s.get("value") or 0 for s in fails.values())
+    total_ckpt = sum(s.get("value") or 0 for s in ckpt.values())
+    # value-based, not series-presence-based: a reset registry keeps its
+    # label series at 0.0, and an all-zero panel is noise
+    if not checks and not total_fail and not total_ckpt:
+        return []
+    out = ["INTEGRITY"]
+    line = f"  checks {int(checks or 0):,}   failures {int(total_fail)}"
+    if total_fail:
+        kinds = ", ".join(
+            f"{(labels[0] if labels else '?')} {int(s.get('value') or 0)}"
+            for labels, s in sorted(fails.items())
+            if s.get("value")
+        )
+        line += f"  ({kinds})  ** CORRUPTION CAUGHT **"
+    out.append(line)
+    if ckpt:
+        ok = (ckpt.get(("ok",)) or {}).get("value") or 0
+        bad = (ckpt.get(("fail",)) or {}).get("value") or 0
+        out.append(f"  ckpt verify ok {int(ok)}   fail {int(bad)}")
+    return out
+
+
 def _compile_lines(snap: dict) -> List[str]:
     requests = _series_map(snap, "gol_compile_cache_requests_total")
     misses = _series_map(snap, "gol_compile_cache_misses_total")
@@ -274,6 +307,7 @@ def render_status(
         _throughput_lines(snap, turns_rate),
         _rpc_lines(snap),
         _wire_lines(snap),
+        _integrity_lines(snap),
         _worker_lines(payload),
         _compile_lines(snap),
         _hbm_lines(snap),

@@ -38,6 +38,7 @@ from ..obs import flight as _flight
 from ..obs import instruments as _ins
 from ..obs import tracing as _tracing
 from . import faults as _faults
+from . import integrity as _integrity
 from .client import RpcClient, RpcError
 from .protocol import Methods, Request, Response
 from .server import RpcServer
@@ -205,15 +206,24 @@ class _ResidentPlan:
     the batch depth K, and each strip's boundary rows at the current turn
     (``edges[i] = (top K rows, bottom K rows)``) — the only state that has
     to move per batch (the broker relays worker i-1's bottom edge and
-    worker i+1's top edge down as worker i's next halos)."""
+    worker i+1's top edge down as worker i's next halos).
 
-    __slots__ = ("active", "bounds", "k", "edges")
+    ``digests[i]`` is the broker-side digest chain of worker i's resident
+    strip at the committed turn (rpc/integrity.py): anchored at seed time
+    from the rows the broker itself sent, advanced from each verified
+    ``StripStep`` reply's post-batch digest, and compared against the
+    reply's PRE-batch digest — so a strip silently mutated between
+    batches fails the very next step. ``None`` means "not tracked" (the
+    worker never attested: version skew or ``-integrity off``)."""
 
-    def __init__(self, active, bounds, k, edges):
+    __slots__ = ("active", "bounds", "k", "edges", "digests")
+
+    def __init__(self, active, bounds, k, edges, digests=None):
         self.active = active
         self.bounds = bounds
         self.k = k
         self.edges = edges
+        self.digests = digests or [None] * len(active)
 
 
 class WorkersBackend:
@@ -245,6 +255,7 @@ class WorkersBackend:
         probe_interval: float = 1.0,
         halo_depth: int = 1,
         sync_interval: int = 256,
+        ckpt_keep: int = 1,
     ):
         if wire not in ("haloed", "full", "resident"):
             raise ValueError(
@@ -269,8 +280,11 @@ class WorkersBackend:
         self._sync_interval = sync_interval
         # None: adaptive (EWMA of observed turn time — _scatter_deadline);
         # a float pins every scatter's reply bound (the -rpc-deadline flag)
+        if ckpt_keep < 1:
+            raise ValueError(f"ckpt_keep must be >= 1, got {ckpt_keep}")
         self._rpc_deadline = rpc_deadline
         self._auto_checkpoint = auto_checkpoint  # (seconds, path) or None
+        self._ckpt_keep = ckpt_keep  # auto-checkpoint generations retained
         self._probe_interval = probe_interval
         self._turn_seconds: float | None = None  # EWMA, turn-loop-local
         self._last_ckpt = 0.0
@@ -627,7 +641,14 @@ class WorkersBackend:
                 edges = [
                     (world[s:s + k], world[e - k:e]) for s, e in bounds
                 ]
-                return _ResidentPlan(active, bounds, k, edges)
+                # anchor the digest chain from the rows the broker itself
+                # sent — independent of anything the workers claim
+                digests = (
+                    [_integrity.state_digest(world[s:e]) for s, e in bounds]
+                    if _integrity.enabled()
+                    else None
+                )
+                return _ResidentPlan(active, bounds, k, edges, digests)
             for i in dead:
                 self._mark_lost(active[i], "resident seed failed")
 
@@ -662,6 +683,21 @@ class WorkersBackend:
                 # the one we seeded (never silently assemble it)
                 self._mark_lost(plan.active[i], "resident lockstep divergence")
                 ok = False
+            elif plan.digests[i] is not None and _integrity.enabled():
+                # the gathered bytes must hash to the committed chain: a
+                # strip corrupted since its last verified step must never
+                # be assembled into the broker's board
+                _ins.INTEGRITY_CHECKS_TOTAL.inc()
+                if _integrity.state_digest(strip) != plan.digests[i]:
+                    self._integrity_suspect(
+                        plan, i, "fetch",
+                        f"fetched strip at turn {turn} does not match "
+                        "the committed digest chain",
+                    )
+                    self._mark_lost(
+                        plan.active[i], "resident fetch digest mismatch"
+                    )
+                    ok = False
         if not ok:
             return False
         # concatenate copies out of the receive-buffer views (protocol-5
@@ -715,6 +751,23 @@ class WorkersBackend:
                 # one that finished the failed batch (t1 + k) is healthy
                 # but ahead — its rows are reconstructed instead
                 if res.turns_completed == t1 and strip.shape == (e - s, base.shape[1]):
+                    if plan.digests[i] is not None and _integrity.enabled():
+                        # a survivor's rows enter the rebuilt board
+                        # verbatim — verify them against the chain first;
+                        # on mismatch fall through to the local recompute
+                        # (bit-identical by construction) instead
+                        _ins.INTEGRITY_CHECKS_TOTAL.inc()
+                        if _integrity.state_digest(strip) != plan.digests[i]:
+                            self._integrity_suspect(
+                                plan, i, "fetch",
+                                f"survivor strip at turn {t1} does not "
+                                "match the committed digest chain",
+                            )
+                            self._mark_lost(
+                                plan.active[i],
+                                "resident recovery digest mismatch",
+                            )
+                            continue
                     parts[i] = strip
         world = np.empty_like(base)
         steps = t1 - t0
@@ -841,6 +894,8 @@ class WorkersBackend:
                             )
                         )
                     results, dead = self._bounded_gather(futures, deadline)
+                    check = _integrity.enabled()
+                    attests = [None] * n
                     for i, res in enumerate(results):
                         if res is None:
                             continue
@@ -854,6 +909,76 @@ class WorkersBackend:
                             # not a committable strip
                             dead.append(i)
                             results[i] = None
+                            continue
+                        dig = getattr(res, "digests", None) if check else None
+                        if not isinstance(dig, dict):
+                            # non-attesting peer (version skew, or its
+                            # -integrity is off): skew-safe skip — the
+                            # chain stops being tracked for this worker
+                            continue
+                        # digest chain: the strip this worker stepped FROM
+                        # must be the strip the broker last committed for
+                        # it — an in-place corruption between batches
+                        # (bit flip, buggy kernel scribble) fails here,
+                        # within one K-turn batch of happening
+                        _ins.INTEGRITY_CHECKS_TOTAL.inc()
+                        if (
+                            plan.digests[i] is not None
+                            and dig.get("pre") != plan.digests[i]
+                        ):
+                            self._integrity_suspect(
+                                plan, i, "strip",
+                                f"pre-batch strip digest at turn {turn0} "
+                                "does not match the committed chain",
+                            )
+                            dead.append(i)
+                            results[i] = None
+                            continue
+                        # reply-edge digest: covers the worker-side
+                        # serialisation of the rows the neighbours will
+                        # step from next batch
+                        _ins.INTEGRITY_CHECKS_TOTAL.inc()
+                        if dig.get("edges") != _integrity.state_digest(edges):
+                            self._integrity_suspect(
+                                plan, i, "edges",
+                                "returned edge rows do not match their "
+                                "attested digest",
+                            )
+                            dead.append(i)
+                            results[i] = None
+                            continue
+                        attests[i] = (
+                            dig.get("attest_top"), dig.get("attest_bottom")
+                        )
+                    # halo cross-attestation: neighbouring strips compute
+                    # the boundary band REDUNDANTLY at every intermediate
+                    # shrinking step (worker i's block starts where worker
+                    # i-1's ends), so their rolled band digests must agree —
+                    # a worker computing wrong rows near a boundary is
+                    # caught here, in the same batch, instead of poisoning
+                    # the board until the next sync. Disagreement cannot
+                    # name the liar, so BOTH are suspects: recovery
+                    # rebuilds from the verified last sync either way.
+                    suspects = set()
+                    for i in range(n):
+                        up = (i - 1) % n
+                        if results[i] is None or results[up] is None:
+                            continue
+                        a, b = attests[i], attests[up]
+                        if not a or not b or not a[0] or not b[1]:
+                            continue
+                        _ins.INTEGRITY_CHECKS_TOTAL.inc()
+                        if a[0] != b[1]:
+                            self._integrity_suspect(
+                                plan, i, "attest",
+                                f"boundary band digests disagree with "
+                                f"worker {up} across the batch at turn "
+                                f"{turn0}",
+                            )
+                            suspects.update((i, up))
+                    for i in suspects:
+                        dead.append(i)
+                        results[i] = None
                     if dead:
                         with self._lock:
                             if self._quit:
@@ -883,6 +1008,15 @@ class WorkersBackend:
                             total += int(counts[-1])
                     for i, res in enumerate(results):
                         plan.edges[i] = (res.edges[:k], res.edges[k:])
+                        # advance the digest chain to the committed turn
+                        # (None = this worker stopped attesting: the chain
+                        # is no longer checkable for it, never guessed)
+                        dig = getattr(res, "digests", None)
+                        plan.digests[i] = (
+                            dig.get("strip")
+                            if check and isinstance(dig, dict)
+                            else None
+                        )
                     with self._lock:
                         self._turn = turn0 + k
                         self._record_alive(turn0 + k, total)
@@ -924,6 +1058,19 @@ class WorkersBackend:
         self._alive = (turn, count)
 
     # -- fault tolerance ---------------------------------------------------
+
+    def _integrity_suspect(self, plan, i, kind: str, detail: str) -> None:
+        """Record one integrity violation loudly (metric by kind, flight
+        event, error log). The caller then routes the suspect through the
+        EXISTING loss machinery — recovery rebuilds the committed turn
+        from the last verified sync, the probe quarantines/readmits."""
+        _ins.INTEGRITY_FAILURES_TOTAL.labels(kind).inc()
+        with self._lock:
+            addr = self._client_addr.get(id(plan.active[i]), "<local>")
+        _flight.record("integrity.fail", addr, check=kind)
+        logger.error(
+            "INTEGRITY violation (%s) from worker %s: %s", kind, addr, detail
+        )
 
     def _ckpt_due(self) -> bool:
         """Whether the time-based auto-checkpoint wants to write — split
@@ -1059,7 +1206,11 @@ class WorkersBackend:
             # pre-syncs when _ckpt_due, so this is normally current), and
             # a checkpoint must never pair a stale board with a newer turn
             world, turn = self._world, self._sync_turn
-        from ..engine.checkpoint import npz_path, save_checkpoint
+        from ..engine.checkpoint import (
+            npz_path,
+            rotate_generations,
+            save_checkpoint,
+        )
         from ..models import CONWAY
 
         try:
@@ -1067,6 +1218,10 @@ class WorkersBackend:
             tmp = p.with_name(p.name + ".tmp")
             # CONWAY unconditionally: run() refused any other rule at entry
             written = save_checkpoint(tmp, world, turn, CONWAY)
+            # -ckpt-keep N: shift current -> .g1 -> ... BEFORE the rename,
+            # so a later generation that still verifies survives a write
+            # (or a run) that corrupts the newest one
+            rotate_generations(p, self._ckpt_keep)
             written.replace(npz_path(p))
         except Exception as exc:
             logger.error("auto-checkpoint at turn %d failed: %s", turn, exc)
@@ -1430,6 +1585,7 @@ def serve(
     resume=None,
     probe_interval: float = 1.0,
     sync_interval: int = 256,
+    ckpt_keep: int = 1,
 ) -> tuple[RpcServer, BrokerService]:
     server = RpcServer(host=host, port=port)
     impl = (
@@ -1441,6 +1597,7 @@ def serve(
             probe_interval=probe_interval,
             halo_depth=halo_depth,
             sync_interval=sync_interval,
+            ckpt_keep=ckpt_keep,
         )
         if backend == "workers"
         else TpuBackend(halo_depth=halo_depth)
@@ -1514,7 +1671,24 @@ def main(argv=None) -> None:
         "-resume", default=None, metavar="CKPT",
         help="reattach a crashed run: the first fresh Run continues from "
              "this checkpoint's board and turn instead of turn 0 "
-             "(consumed once; later Runs start fresh)",
+             "(consumed once; later Runs start fresh). The checkpoint "
+             "must VERIFY (embedded digest, engine/checkpoint.py); with "
+             "-ckpt-keep N an unverifiable newest generation falls back "
+             "to the newest one that does verify",
+    )
+    parser.add_argument(
+        "-ckpt-keep", dest="ckpt_keep", type=int, default=1, metavar="N",
+        help="checkpoint generations to retain: -auto-checkpoint rotates "
+             "current -> .g1 -> ... before each write, and -resume falls "
+             "back to the newest generation that verifies (default 1: "
+             "current only)",
+    )
+    parser.add_argument(
+        "-integrity", choices=("on", "off"), default="on",
+        help="frame checksums + resident-strip attestation digests "
+             "(rpc/integrity.py). Default on; off disables both "
+             "advertising and checking — an off broker is undefended "
+             "against silent corruption",
     )
     parser.add_argument(
         "-probe-interval", dest="probe_interval", type=float, default=1.0,
@@ -1548,6 +1722,13 @@ def main(argv=None) -> None:
         tracing.enable()
         tracing.set_process_name("broker")
         flight.enable()
+    _integrity.set_enabled(args.integrity == "on")
+    if args.ckpt_keep < 1:
+        parser.error(f"-ckpt-keep must be >= 1, got {args.ckpt_keep}")
+    if args.ckpt_keep != 1 and args.backend != "workers" and not args.resume:
+        parser.error("-ckpt-keep rotates -auto-checkpoint generations "
+                     "(workers backend) and widens -resume's fallback "
+                     "search; it does nothing here")
     if args.halo_depth < 1:
         parser.error(f"-halo-depth must be >= 1, got {args.halo_depth}")
     if (
@@ -1599,12 +1780,25 @@ def main(argv=None) -> None:
         auto_checkpoint = (secs, path)
     resume = None
     if args.resume:
-        from ..engine.checkpoint import load_checkpoint
+        from ..engine.checkpoint import CheckpointError, load_resume_checkpoint
 
         try:
-            resume = load_checkpoint(args.resume)
-        except Exception as exc:
+            # verified-or-refused: a checkpoint that does not hash to its
+            # embedded digest (or carries none) is never reattached; with
+            # -ckpt-keep the fallback walks to the newest generation that
+            # DOES verify before giving up
+            board, turn, rule, gen = load_resume_checkpoint(
+                args.resume, keep=args.ckpt_keep
+            )
+        except CheckpointError as exc:
             parser.error(f"-resume {args.resume}: {exc}")
+        if gen > 0:
+            logger.warning(
+                "-resume %s: newest generation(s) failed verification; "
+                "fell back to verified generation %d (turn %d)",
+                args.resume, gen, turn,
+            )
+        resume = (board, turn, rule)
     addresses = [a for a in args.workers.split(",") if a]
     server, service = serve(
         args.port, args.backend, addresses, host=args.host, wire=args.wire,
@@ -1614,6 +1808,7 @@ def main(argv=None) -> None:
         resume=resume,
         probe_interval=args.probe_interval,
         sync_interval=args.sync_interval,
+        ckpt_keep=args.ckpt_keep,
     )
     print(f"broker listening on :{server.port} (backend={args.backend})", flush=True)
     service.quit_event.wait()

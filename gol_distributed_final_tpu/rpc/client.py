@@ -19,6 +19,7 @@ from ..obs import flight as _flight
 from ..obs import instruments as _ins
 from ..obs import metrics as _metrics
 from ..obs import tracing as _tracing
+from . import integrity as _integrity
 from .protocol import Methods, Request, recv_frame_sized, send_frame
 
 
@@ -101,10 +102,11 @@ class RpcClient:
         call captures both atomically: a send failure can then only ever
         tear down the connection the call actually used, never mark a
         fresh socket dead through a torn sock/closed pair."""
-        # protocol-5 negotiation state resets per transport: a reconnect
-        # may land on an older peer (rolling restart), which must re-prove
-        # out-of-band support before any flagged frame is sent to it
+        # protocol-5 + checksum negotiation state resets per transport: a
+        # reconnect may land on an older peer (rolling restart), which
+        # must re-prove support before any flagged frame is sent to it
         self._peer_oob = False
+        self._peer_ck = False
         closed = threading.Event()
         self._transport = (sock, closed)
         threading.Thread(
@@ -296,14 +298,20 @@ class RpcClient:
         try:
             with self._write_lock:
                 # "oob": 1 advertises this side parses protocol-5 sidecar
-                # frames (old servers ignore unknown envelope keys); the
-                # frame itself only upgrades once the PEER advertised in a
+                # frames, "ck": 1 that it verifies checked frames
+                # (rpc/integrity.py — only advertised with -integrity on;
+                # old servers ignore unknown envelope keys); the frame
+                # itself only upgrades once the PEER advertised in a
                 # reply — so an old server keeps receiving plain frames
+                envelope = {"id": call_id, "method": method,
+                            "request": request, "oob": 1}
+                if _integrity.enabled():
+                    envelope["ck"] = 1
                 sent = send_frame(
                     sock,
-                    {"id": call_id, "method": method, "request": request,
-                     "oob": 1},
+                    envelope,
                     oob=self._peer_oob,
+                    checksum=self._peer_ck and _integrity.enabled(),
                 )
         except OSError as e:
             with self._pending_lock:
@@ -337,6 +345,10 @@ class RpcClient:
             # the peer is new enough to both SEND the key and (being a
             # current server) parse flagged frames: upgrade this transport
             self._peer_oob = True
+        if reply.get("ck"):
+            # the peer verifies checked frames: checksum everything we
+            # send it from now on (it only advertises with -integrity on)
+            self._peer_ck = True
         if _metrics.enabled():
             _ins.RPC_CLIENT_RECEIVED_BYTES_TOTAL.labels(method).inc(
                 slot.get("reply_bytes", 0)

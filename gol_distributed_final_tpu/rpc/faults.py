@@ -9,13 +9,17 @@ Two complementary mechanisms, both dependency-free and off unless a test
   ``wedge_after=N`` (stop forwarding after the N-th frame but hold the
   sockets open — the stalled-but-alive worker the per-scatter deadline
   exists for), ``drop_after=N`` (hard connection close — the SIGKILLed
-  peer), and ``corrupt_frame=N`` (flip payload bytes of exactly frame N —
-  the poisoned wire; byte positions come from the constructor ``seed``, so
-  a failing run replays). The global frame counter spans all connections
-  and both directions, so a wedge also starves NEW connections — the
-  broker's readmission probe cannot readmit a worker through a wedged
-  path. Frame ordering is deterministic for a single proxied connection;
-  across concurrent connections only the per-connection order is.
+  peer), ``corrupt_frame=N`` (flip payload bytes of exactly frame N —
+  the poisoned wire, landing INSIDE the pickle so it is always loud; byte
+  positions come from the constructor ``seed``, so a failing run
+  replays), and ``corrupt_sidecar=N`` (flip ONE BIT inside a raw ndarray
+  sidecar of the first flagged frame >= N — the SILENT corruption class,
+  injectable since the checked-frame layer in rpc/integrity.py exists to
+  catch it). The global frame counter spans all connections and both
+  directions, so a wedge also starves NEW connections — the broker's
+  readmission probe cannot readmit a worker through a wedged path. Frame
+  ordering is deterministic for a single proxied connection; across
+  concurrent connections only the per-connection order is.
 
 * ``fault_point(name)`` — in-process fault sites compiled into the worker
   dispatch, the RPC server, and the broker turn loop, triggered by the
@@ -24,7 +28,9 @@ Two complementary mechanisms, both dependency-free and off unless a test
   entries — ``raise`` (FaultInjected on exactly the k-th hit), ``exit``
   (``os._exit(70)`` on the k-th hit: the crash that runs no finallys,
   kill -9 with a deterministic trigger point), ``sleep`` (sleep ``arg``
-  seconds on every hit >= k), ``wedge`` (block forever from hit k on).
+  seconds on every hit >= k), ``wedge`` (block forever from hit k on),
+  ``corrupt`` (flip one byte of the site's exposed ndarray in place on
+  the k-th hit — ``worker.strip_corrupt`` exposes the resident strip).
   With the env var unset a fault point costs one global read and a dict
   check — cheap enough to keep compiled into the hot paths.
 
@@ -44,7 +50,9 @@ from typing import Optional
 # the proxy frames with the REAL wire header: a private-but-shared import
 # beats re-declaring the struct (a protocol framing change must re-frame
 # the chaos proxy too, not silently desync it)
+from .integrity import CK_WORD_SIZE
 from .protocol import (
+    _FLAG_CK,
     _FLAG_OOB,
     _HEADER,
     _LEN_MASK,
@@ -79,7 +87,7 @@ def _parse(text: str) -> dict:
         if len(parts) not in (2, 3, 4):
             raise ValueError(f"bad fault spec entry {entry!r}")
         name, action = parts[0], parts[1]
-        if action not in ("raise", "exit", "sleep", "wedge"):
+        if action not in ("raise", "exit", "sleep", "wedge", "corrupt"):
             raise ValueError(f"unknown fault action {action!r} in {entry!r}")
         k = int(parts[2]) if len(parts) > 2 else 1
         arg = float(parts[3]) if len(parts) > 3 else 0.0
@@ -99,9 +107,15 @@ def configure(text: Optional[str]) -> None:
         _hits.clear()
 
 
-def fault_point(name: str) -> None:
+def fault_point(name: str, target=None) -> None:
     """A named site a fault can be injected at. No-op (one global read)
-    unless ``GOL_FAULT_POINTS`` / ``configure`` named this site."""
+    unless ``GOL_FAULT_POINTS`` / ``configure`` named this site.
+
+    ``target`` is an optional mutable ndarray the site exposes to the
+    ``corrupt`` action (``name:corrupt:k[:flat_index]``): on exactly the
+    k-th hit one byte of it is flipped IN PLACE — the silent-state
+    corruption the integrity digest chain (rpc/integrity.py) exists to
+    catch. Sites that pass no target make ``corrupt`` a no-op there."""
     global _spec, _loaded
     if not _loaded:
         with _lock:
@@ -130,6 +144,13 @@ def fault_point(name: str) -> None:
         if action == "exit":
             # no finallys, no flushes — the deterministic kill -9
             os._exit(70)
+        if action == "corrupt" and target is not None and target.size:
+            # deterministic single-byte flip at flat index ``arg`` (mod
+            # size). XOR 0xFF maps a 0/255 cell to its VALID opposite —
+            # exactly the plausible-looking wrong bit nothing downstream
+            # would notice without a digest
+            flat = target.reshape(-1)
+            flat[int(arg) % flat.size] ^= 0xFF
 
 
 # -- TCP chaos proxy ---------------------------------------------------------
@@ -155,17 +176,20 @@ class ChaosProxy:
         wedge_after: Optional[int] = None,
         drop_after: Optional[int] = None,
         corrupt_frame: Optional[int] = None,
+        corrupt_sidecar: Optional[int] = None,
     ):
         host, port = target.rsplit(":", 1)
         self._target = (host, int(port))
         self._seed = seed
         self._lock = threading.Lock()
         self._frames = 0
+        self._sidecar_corrupted = False
         self._faults = {
             "delay": delay,
             "wedge_after": wedge_after,
             "drop_after": drop_after,
             "corrupt_frame": corrupt_frame,
+            "corrupt_sidecar": corrupt_sidecar,
         }
         self._closed = threading.Event()
         self._conns: list = []
@@ -231,8 +255,13 @@ class ChaosProxy:
                 # mask the protocol-5 out-of-band flag bit: a flagged
                 # header's length field is the body length either way, and
                 # the body (subheader + pickle + sidecar buffers) forwards
-                # as one opaque blob
+                # as one opaque blob. A CHECKED frame carries a crc32
+                # word behind the length word (rpc/protocol.py) — part of
+                # the header, forwarded untouched: corruption lands in
+                # the BODY, and the stale crc is exactly what convicts it
                 oob = bool(word & _FLAG_OOB)
+                if word & _FLAG_CK:
+                    head += _recv_exact(src, CK_WORD_SIZE)
                 length = word & _LEN_MASK
                 payload = _recv_exact(src, length)
                 with self._lock:
@@ -250,17 +279,47 @@ class ChaosProxy:
                 drop = faults["drop_after"]
                 if drop is not None and idx >= drop:
                     return  # finally closes both: the hard kill
+                sidecar = faults["corrupt_sidecar"]
+                if (
+                    sidecar is not None
+                    and idx >= sidecar
+                    and oob
+                    and not self._sidecar_corrupted
+                ):
+                    # flip ONE BIT inside a raw ndarray sidecar buffer —
+                    # the silent-board-corruption fault corrupt_frame
+                    # deliberately never lands (its flips stay inside the
+                    # pickle so they surface as unpickling errors). This
+                    # knob exists to prove the checked-frame layer
+                    # (rpc/integrity.py): against a checksum-negotiated
+                    # peer the flip is a loud IntegrityError; against an
+                    # -integrity off peer it IS a silently-wrong board —
+                    # by design, that run is undefended. Fires once, on
+                    # the first flagged frame >= N that carries sidecar
+                    # bytes.
+                    body = bytearray(payload)
+                    if length > _OOB_SUB.size:
+                        nbufs, pickle_len = _OOB_SUB.unpack_from(body, 0)
+                        s0 = _OOB_SUB.size + _OOB_LEN.size * nbufs + pickle_len
+                        s_end = length
+                        if s_end > s0:
+                            rng = random.Random(self._seed ^ idx)
+                            pos = rng.randrange(s0, s_end)
+                            body[pos] ^= 1 << rng.randrange(8)
+                            payload = bytes(body)
+                            self._sidecar_corrupted = True
                 corrupt = faults["corrupt_frame"]
                 if corrupt is not None and idx == corrupt and length:
                     body = bytearray(payload)
-                    # the corruption must land INSIDE the pickle bytes so
-                    # it surfaces as a deterministic UnpicklingError, never
-                    # a silently-wrong board: for a plain frame the pickle
-                    # IS the body (byte 0 = the PROTO opcode); for an
-                    # out-of-band frame the pickle sits after the subheader
-                    # — flipping a sidecar BUFFER byte would be exactly the
-                    # silent board corruption this proxy promises never to
-                    # produce
+                    # corrupt_frame's corruption must land INSIDE the
+                    # pickle bytes so it surfaces loudly even against an
+                    # un-negotiated peer (UnpicklingError on a plain
+                    # frame; IntegrityError first on a checked one): for
+                    # a plain frame the pickle IS the body (byte 0 = the
+                    # PROTO opcode); for an out-of-band frame the pickle
+                    # sits after the subheader. Flipping a sidecar BUFFER
+                    # byte is the SILENT corruption class — that is the
+                    # separate, deliberate corrupt_sidecar knob above
                     if oob and length > _OOB_SUB.size:
                         nbufs, pickle_len = _OOB_SUB.unpack_from(body, 0)
                         p0 = _OOB_SUB.size + _OOB_LEN.size * nbufs

@@ -23,6 +23,11 @@ from typing import List, Optional
 
 import numpy as np
 
+from ..obs import instruments as _ins
+from ..obs import metrics as _metrics
+from . import integrity as _integrity
+from .integrity import CK_WORD_SIZE, IntegrityError
+
 
 class Methods:
     """Method-name constants (stubs/stubs.go:5-11)."""
@@ -107,6 +112,13 @@ class Response:
     # no gather needed).
     edges: Optional[np.ndarray] = None
     counts: Optional[List] = None
+    # extension: the integrity attestation payload (rpc/integrity.py) — a
+    # plain dict of digest strings/lists, so it crosses the restricted
+    # unpickler. StripStep replies carry {"pre", "strip", "edges",
+    # "attest_top", "attest_bottom"}; readers use getattr + isinstance
+    # (absent on a version-skewed or -integrity off peer's pickle — skew
+    # degrades to "no attestation", never an AttributeError).
+    digests: Optional[dict] = None
 
 
 # -- deserialisation allowlist ----------------------------------------------
@@ -163,16 +175,35 @@ def loads_restricted(payload: bytes, buffers=None):
 #   into a preallocated buffer the unpickled array then WRAPS (no
 #   parse-time copy).
 #
-# Skew safety: MAX_FRAME < 2^34 keeps bit 63 free, so an OLD receiver that
-# is sent a flagged frame fails its length check loudly (connection drop,
-# never a mis-parse) — and the RPC layer only ever sends flagged frames to
-# peers that advertised support in their envelopes (rpc/client.py,
-# rpc/server.py), so old peers keep getting plain protocol-HIGHEST frames.
+# A third header bit (62) flags a CHECKED frame (rpc/integrity.py): a
+# 4-byte crc32 word covering every body byte — the subheader, the
+# pickle, and every raw sidecar buffer — rides immediately after the
+# length word, IN the same sendall as the header. In-header rather than
+# trailing deliberately: a trailer would land in its own late TCP
+# segment, and a receiver that has already drained the body then blocks
+# on 4 bytes whose delivery waits on the sender thread being scheduled
+# again — a per-frame scheduling stall that measured as double-digit
+# percent on a loopback cluster, vs. the crc compute's microseconds. The
+# sender has every body piece in memory before the first sendall anyway,
+# so hashing first costs nothing. The receiver folds each piece into the
+# crc as it arrives and verifies BEFORE anything is unpickled: a
+# mismatch is a loud IntegrityError, never a parse. Like the out-of-band
+# flag, the checksum is negotiated per transport ("ck": 1 in the
+# envelopes), so an un-advertising old peer only ever receives unflagged
+# frames.
+#
+# Skew safety: MAX_FRAME < 2^34 keeps bits 62-63 free, so an OLD receiver
+# that is sent a flagged frame fails its length check loudly (connection
+# drop, never a mis-parse) — and the RPC layer only ever sends flagged
+# frames to peers that advertised support in their envelopes
+# (rpc/client.py, rpc/server.py), so old peers keep getting plain
+# protocol-HIGHEST frames.
 
 _HEADER = struct.Struct(">Q")
 MAX_FRAME = 1 << 34  # 16 GiB: a 65536^2 board is ~4 GiB
 _FLAG_OOB = 1 << 63
-_LEN_MASK = _FLAG_OOB - 1
+_FLAG_CK = 1 << 62
+_LEN_MASK = _FLAG_CK - 1
 _OOB_SUB = struct.Struct(">IQ")  # (nbufs, pickle_len)
 _OOB_LEN = struct.Struct(">Q")  # one sidecar buffer's length
 # below this, a buffer stays in-band: two syscalls + a subheader entry cost
@@ -183,20 +214,28 @@ _OOB_THRESHOLD = 1024
 _MAX_OOB_BUFFERS = 4096
 
 
-def send_frame(sock, obj, oob: bool = False) -> int:
+def send_frame(sock, obj, oob: bool = False, checksum: bool = False) -> int:
     """Callers must serialise sends per-socket (both RpcClient and RpcServer
     hold a write lock). Separate sendalls avoid concatenating header+payload,
     which would double peak memory on multi-GiB board frames. Returns the
     frame size in bytes (header + payload) — the senders' byte meters.
 
-    ``oob=True`` selects the protocol-5 out-of-band shape; the caller is
-    asserting the peer can parse it (the envelope negotiation in
-    rpc/client.py / rpc/server.py)."""
+    ``oob=True`` selects the protocol-5 out-of-band shape; ``checksum=True``
+    sends the in-header crc32 word over the whole body (pickle AND
+    sidecars — rpc/integrity.py). Either flag asserts the peer can parse
+    the shape (the envelope negotiation in rpc/client.py /
+    rpc/server.py)."""
     if not oob:
         payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-        sock.sendall(_HEADER.pack(len(payload)))
+        if checksum:
+            head = _HEADER.pack(_FLAG_CK | len(payload)) + _integrity.crc_pack(
+                _integrity.crc_add(0, payload)
+            )
+        else:
+            head = _HEADER.pack(len(payload))
+        sock.sendall(head)
         sock.sendall(payload)
-        return _HEADER.size + len(payload)
+        return len(head) + len(payload)
     raws = []
 
     def _sidecar(pb: pickle.PickleBuffer):
@@ -211,12 +250,21 @@ def send_frame(sock, obj, oob: bool = False) -> int:
         _OOB_LEN.pack(r.nbytes) for r in raws
     )
     total = len(sub) + len(payload) + sum(r.nbytes for r in raws)
-    sock.sendall(_HEADER.pack(_FLAG_OOB | total))
+    if checksum:
+        crc = _integrity.crc_add(_integrity.crc_add(0, sub), payload)
+        for raw in raws:
+            crc = _integrity.crc_add(crc, raw)
+        head = _HEADER.pack(
+            _FLAG_OOB | _FLAG_CK | total
+        ) + _integrity.crc_pack(crc)
+    else:
+        head = _HEADER.pack(_FLAG_OOB | total)
+    sock.sendall(head)
     sock.sendall(sub)
     sock.sendall(payload)
     for raw in raws:
         sock.sendall(raw)  # the array's own memory: zero-copy send
-    return _HEADER.size + total
+    return len(head) + total
 
 
 def _recv_exact(sock, n: int) -> bytes:
@@ -242,19 +290,64 @@ def _recv_into_exact(sock, buf) -> None:
         got += n
 
 
+def _verify_crc(want: bytes, crc: int, what: str) -> None:
+    """Verify the computed body crc against the frame's in-header crc
+    word — the checked-frame gate: on mismatch the frame is never parsed,
+    the stream never trusted again (IntegrityError is a ConnectionError).
+    Counted either way."""
+    if _metrics.enabled():
+        _ins.INTEGRITY_CHECKS_TOTAL.inc()
+    try:
+        _integrity.crc_check(crc, want, what)
+    except IntegrityError:
+        _ins.INTEGRITY_FAILURES_TOTAL.labels("frame").inc()
+        raise
+
+
 def recv_frame_sized(sock):
     """``(obj, frame_bytes)`` — the receivers' byte meters ride along."""
-    (word,) = _HEADER.unpack(_recv_exact(sock, _HEADER.size))
+    # opportunistic 12-byte first read: a checked frame's crc word rides
+    # right behind the length word in the sender's single header sendall,
+    # so asking for both up front costs no extra syscall — and for an
+    # unchecked frame the surplus (≤ 4 bytes) is simply the body's first
+    # bytes, consumed below. One recv per frame header either way; an
+    # extra per-frame syscall measures whole percents on hosts with slow
+    # syscall paths (gVisor-class sandboxes, the loopback bench)
+    head = b""
+    while len(head) < _HEADER.size:
+        chunk = sock.recv(_HEADER.size + CK_WORD_SIZE - len(head))
+        if not chunk:
+            raise ConnectionError("peer closed the connection")
+        head += chunk
+    (word,) = _HEADER.unpack(head[:_HEADER.size])
+    extra = head[_HEADER.size:]  # 0..4 bytes already past the length word
     length = word & _LEN_MASK
+    checked = bool(word & _FLAG_CK)
     if length > MAX_FRAME:
         raise ConnectionError(f"frame of {length} bytes exceeds limit")
+    if checked:
+        if len(extra) < CK_WORD_SIZE:
+            extra += _recv_exact(sock, CK_WORD_SIZE - len(extra))
+        want, pre = extra, b""
+    else:
+        want, pre = b"", extra
+        if len(pre) > length:
+            # no real pickle is under 4 bytes (PROTO + opcode + STOP),
+            # so a shorter claimed length means a mis-framed stream —
+            # refuse rather than bleed the surplus into the next frame
+            raise ConnectionError(f"implausibly short frame ({length} B)")
+    head_len = _HEADER.size + (CK_WORD_SIZE if checked else 0)
     if not word & _FLAG_OOB:
-        return loads_restricted(_recv_exact(sock, length)), _HEADER.size + length
+        payload = pre + _recv_exact(sock, length - len(pre))
+        if checked:
+            _verify_crc(want, _integrity.crc_add(0, payload), "pickle body")
+        return loads_restricted(payload), head_len + length
     # out-of-band shape: every subheader quantity is validated against the
     # framed length BEFORE any allocation happens on its say-so
     if length < _OOB_SUB.size:
         raise ConnectionError("out-of-band frame shorter than its subheader")
-    nbufs, pickle_len = _OOB_SUB.unpack(_recv_exact(sock, _OOB_SUB.size))
+    sub = pre + _recv_exact(sock, _OOB_SUB.size - len(pre))
+    nbufs, pickle_len = _OOB_SUB.unpack(sub)
     if nbufs > _MAX_OOB_BUFFERS:
         raise ConnectionError(f"frame claims {nbufs} sidecar buffers")
     lens_blob = _recv_exact(sock, _OOB_LEN.size * nbufs)
@@ -265,12 +358,24 @@ def recv_frame_sized(sock):
     if _OOB_SUB.size + _OOB_LEN.size * nbufs + pickle_len + sum(buf_lens) != length:
         raise ConnectionError("out-of-band frame length mismatch")
     payload = _recv_exact(sock, pickle_len)
+    crc = 0
+    if checked:
+        crc = _integrity.crc_add(
+            _integrity.crc_add(_integrity.crc_add(0, sub), lens_blob), payload
+        )
     buffers = []
     for n in buf_lens:
         buf = bytearray(n)
         _recv_into_exact(sock, buf)
+        if checked:
+            crc = _integrity.crc_add(crc, buf)
         buffers.append(buf)
-    return loads_restricted(payload, buffers), _HEADER.size + length
+    if checked:
+        # verified BEFORE the unpickle wraps any sidecar: a flipped bit in
+        # a raw ndarray buffer — the silent-board-corruption class — is a
+        # loud refusal here, never a wrong cell downstream
+        _verify_crc(want, crc, f"body + {nbufs} sidecar(s)")
+    return loads_restricted(payload, buffers), head_len + length
 
 
 def recv_frame(sock):

@@ -18,6 +18,7 @@ from ..obs import instruments as _ins
 from ..obs import metrics as _metrics
 from ..obs import tracing as _tracing
 from . import faults as _faults
+from . import integrity as _integrity
 from .protocol import Response, recv_frame_sized, send_frame
 
 # structured error replies carry the remote traceback's TAIL (the raise
@@ -66,11 +67,11 @@ class RpcServer:
 
     def _serve_conn(self, conn: socket.socket) -> None:
         write_lock = threading.Lock()
-        # per-connection protocol-5 capability: flips once the peer's
-        # envelope advertises it, after which replies may use out-of-band
-        # frames; an old client never advertises and keeps getting plain
-        # frames (the skew contract, rpc/protocol.py)
-        peer = {"oob": False}
+        # per-connection protocol-5 + checksum capability: each flips once
+        # the peer's envelope advertises it, after which replies may use
+        # out-of-band / checked frames; an old client never advertises and
+        # keeps getting plain frames (the skew contract, rpc/protocol.py)
+        peer = {"oob": False, "ck": False}
         try:
             while True:
                 try:
@@ -104,6 +105,8 @@ class RpcServer:
             envelope = msg if isinstance(msg, dict) else {}
             if peer is not None and envelope.get("oob"):
                 peer["oob"] = True
+            if peer is not None and envelope.get("ck"):
+                peer["ck"] = True
             call_id = envelope.get("id")
             if call_id is None:
                 return  # not a call envelope: no reply is owed
@@ -169,13 +172,19 @@ class RpcServer:
                 _tracing.end_span(span)
             try:
                 # "oob": 1 in every reply envelope advertises protocol-5
-                # support to the CLIENT (old clients ignore unknown keys);
-                # the reply frame itself only upgrades once this peer
+                # support to the CLIENT, "ck": 1 checked-frame support
+                # (rpc/integrity.py; old clients ignore unknown keys); the
+                # reply frame itself only upgrades once this peer
                 # advertised in a request envelope
                 reply["oob"] = 1
+                if _integrity.enabled():
+                    reply["ck"] = 1
                 with write_lock:
                     sent = send_frame(
-                        conn, reply, oob=bool(peer and peer["oob"])
+                        conn, reply, oob=bool(peer and peer["oob"]),
+                        checksum=bool(
+                            peer and peer["ck"] and _integrity.enabled()
+                        ),
                     )
                 if _metrics.enabled():
                     _ins.RPC_SERVER_SENT_BYTES_TOTAL.labels(verb).inc(sent)

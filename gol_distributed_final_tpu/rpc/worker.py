@@ -23,6 +23,7 @@ import threading
 import numpy as np
 
 from . import faults as _faults
+from . import integrity as _integrity
 from .protocol import Methods, Request, Response
 from .server import RpcServer
 
@@ -63,8 +64,12 @@ def compute_strip_haloed(padded: np.ndarray) -> np.ndarray:
 
 
 def strip_step_batch(
-    strip: np.ndarray, top: np.ndarray, bottom: np.ndarray, k: int
-) -> tuple[np.ndarray, list[int]]:
+    strip: np.ndarray,
+    top: np.ndarray,
+    bottom: np.ndarray,
+    k: int,
+    attest: bool = False,
+):
     """Advance a resident strip K turns from depth-K halo rows, in
     shrinking form: the (h + 2K)-row padded block loses one row per side
     per step, landing exactly on the K-turns-later strip — the same
@@ -72,7 +77,26 @@ def strip_step_batch(
     in the reference-shaped numpy kernel. Returns ``(next_strip,
     per_step_alive_counts)``: the counts are of the STRIP's rows only, so
     summing them across workers gives the whole board's count per turn
-    (the AliveCellsCount feed, no gather)."""
+    (the AliveCellsCount feed, no gather).
+
+    ``attest=True`` additionally returns two band digests
+    ``(..., attest_top, attest_bottom)`` — the halo cross-attestation
+    feed (rpc/integrity.py). After step j (1-based) the padded block
+    covers rows ``[s-(k-j), e+(k-j))`` of the board at turn ``t+j``; its
+    FIRST ``2*(k-j)`` rows are exactly the rows the UPPER neighbour's
+    block ends with at the same step (both strips compute the band
+    ``[s-(k-j), s+(k-j))`` redundantly from the same turn-t inputs).
+    Each side's per-step bands fold into ONE rolling state digest per
+    batch (each fold binds the band's shape, so the step structure is
+    pinned twice over: by the lockstep (k, width) contract and by the
+    digest itself) — stream equality is band-wise equality, at one
+    digest's cost, and no intermediate step's array outlives its fold.
+    Worker i's ``attest_top`` must hash-equal worker i-1's
+    ``attest_bottom``: the broker cross-checks every batch, and a worker
+    computing wrong rows anywhere in a boundary's dependency cone is
+    caught within the batch (≤K turns). The final step's band is empty
+    (zero rows — folds only its shape header; k=1 attests the empty
+    band, which still compares)."""
     h = strip.shape[0]
     if k < 1:
         raise ValueError(f"strip batch needs k >= 1, got {k}")
@@ -83,10 +107,22 @@ def strip_step_batch(
         )
     padded = np.concatenate([top, strip, bottom], axis=0)
     counts = []
+    at = ab = _integrity.state_new()
     for i in range(k):
         padded = _strip_step(padded)  # 2 fewer rows per step
         off = k - (i + 1)
         counts.append(int(np.count_nonzero(padded[off : off + h])))
+        if attest:
+            # fold the bands NOW: keeping views of every step's padded
+            # intermediate until batch end would hold ~K full strips live
+            band = 2 * off
+            at = _integrity.state_add(at, padded[:band])
+            ab = _integrity.state_add(ab, padded[padded.shape[0] - band:])
+    if attest:
+        return (
+            padded, counts,
+            _integrity.state_hex(at), _integrity.state_hex(ab),
+        )
     return padded, counts
 
 
@@ -167,18 +203,49 @@ class WorkerService:
                 raise ValueError(
                     f"batch depth {k} exceeds strip height {self._strip.shape[0]}"
                 )
-            strip, counts = strip_step_batch(self._strip, halos[:k], halos[k:], k)
+            # chaos site (rpc/faults.py "corrupt" action): flips a byte of
+            # the RESIDENT strip in place — the silent-state-corruption
+            # fault the digest chain below exists to catch. Placed before
+            # the pre-digest so the corruption is visible to it: the
+            # broker's chain comparison then refuses this reply.
+            _faults.fault_point("worker.strip_corrupt", target=self._strip)
+            check = _integrity.enabled()
+            pre = _integrity.state_digest(self._strip) if check else None
+            if check:
+                strip, counts, att_top, att_bottom = strip_step_batch(
+                    self._strip, halos[:k], halos[k:], k, attest=True
+                )
+            else:
+                strip, counts = strip_step_batch(
+                    self._strip, halos[:k], halos[k:], k
+                )
             self._strip = strip
             self._strip_turn += k
             # the fresh boundary rows: the broker relays them to this
             # strip's neighbours as their next batch's halos — the only
             # state that leaves this process per batch
             edges = np.concatenate([strip[:k], strip[-k:]], axis=0)
+            digests = None
+            if check:
+                # the attestation payload (rpc/integrity.py): "pre"/"strip"
+                # anchor the broker's per-strip digest chain (in-place
+                # corruption between batches is caught on the NEXT step),
+                # "edges" covers worker-side serialisation of the reply
+                # rows, and the attest digests feed the neighbour
+                # cross-check
+                digests = {
+                    "pre": pre,
+                    "strip": _integrity.state_digest(strip),
+                    "edges": _integrity.state_digest(edges),
+                    "attest_top": att_top,
+                    "attest_bottom": att_bottom,
+                }
             return Response(
                 worker=req.worker,
                 turns_completed=self._strip_turn,
                 edges=edges,
                 counts=counts,
+                digests=digests,
             )
 
     def strip_fetch(self, req: Request) -> Response:
@@ -244,7 +311,15 @@ def main(argv=None) -> None:
              "dispatch spans join the broker's trace via Request.trace_ctx "
              "and ship back in Status replies",
     )
+    parser.add_argument(
+        "-integrity", choices=("on", "off"), default="on",
+        help="frame checksums + resident-strip attestation digests "
+             "(rpc/integrity.py). Default on; off disables both "
+             "advertising and computing — an off worker is undefended "
+             "against silent corruption",
+    )
     args = parser.parse_args(argv)
+    _integrity.set_enabled(args.integrity == "on")
     if args.metrics:
         from ..obs import metrics
 

@@ -1,0 +1,940 @@
+"""End-to-end data-integrity suite (rpc/integrity.py + the three planes).
+
+Covers the silent-corruption contract PR 4/5 left open:
+
+* **Checked frames** (rpc/protocol.py) — in-header crc32 round-trip over
+  both frame shapes, refusal-before-parse on any flipped byte (pickle,
+  sidecar, or the crc word itself), and version skew in both directions
+  (a non-advertising peer never receives a checked frame; a checked
+  frame reaching an old receiver fails loudly, never mis-parses).
+* **Halo cross-attestation** (rpc/worker.py + rpc/broker.py) — the
+  redundant-boundary-band digest math on uneven splits (wraparound
+  included), the per-strip digest chain, and the recovery path: an
+  in-place strip corruption or a sidecar bit flip is detected within one
+  K-turn batch and the run still finishes bit-identical to the oracle —
+  while the same faults against ``-integrity off`` are proven SILENT
+  (the undefended half of the contract).
+* **Verified checkpoints** (engine/checkpoint.py) — digest round-trip,
+  typed actionable errors for every way an npz can be wrong,
+  ``-ckpt-keep`` generation rotation, and the ``-resume`` fallback that
+  never reattaches unverified state.
+
+Fast deterministic tests run in tier-1; the live subprocess-cluster
+corruption scenarios are ``slow``-marked (``scripts/check --integrity``
+runs everything).
+"""
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from gol_distributed_final_tpu.engine.checkpoint import (
+    CheckpointError,
+    checkpoint_digest,
+    generation_path,
+    load_checkpoint,
+    load_resume_checkpoint,
+    load_verified_checkpoint,
+    npz_path,
+    rotate_generations,
+    save_checkpoint,
+    save_packed_checkpoint,
+)
+from gol_distributed_final_tpu.models import CONWAY
+from gol_distributed_final_tpu.obs import metrics as obs_metrics
+from gol_distributed_final_tpu.rpc import faults, integrity
+from gol_distributed_final_tpu.rpc import worker as rpc_worker
+from gol_distributed_final_tpu.rpc.broker import WorkersBackend
+from gol_distributed_final_tpu.rpc.client import RpcClient
+from gol_distributed_final_tpu.rpc.faults import ChaosProxy
+from gol_distributed_final_tpu.rpc.integrity import IntegrityError
+from gol_distributed_final_tpu.rpc.protocol import (
+    MAX_FRAME,
+    Request,
+    Response,
+    _FLAG_CK,
+    _FLAG_OOB,
+    _HEADER,
+    loads_restricted,
+    recv_frame_sized,
+    send_frame,
+)
+from gol_distributed_final_tpu.rpc.server import RpcServer
+
+from oracle import vector_step
+from test_chaos import _counter, _kill_all
+from test_rpc import _spawn, _wait_listening
+
+
+@pytest.fixture(autouse=True)
+def integrity_on():
+    """Every test starts from the default-on posture and restores it —
+    the undefended tests flip the global off and must not leak that."""
+    integrity.set_enabled(True)
+    yield
+    integrity.set_enabled(True)
+
+
+@pytest.fixture
+def clean_faults():
+    faults.configure(None)
+    yield faults
+    faults.configure(None)
+
+
+@pytest.fixture
+def live_metrics():
+    obs_metrics.enable()
+    obs_metrics.registry().reset()
+    yield obs_metrics
+    obs_metrics.enable(False)
+
+
+def _labeled(name: str, snap=None) -> dict:
+    """{labels_tuple: value} for one counter family. Zero-valued series
+    are dropped: registry().reset() keeps registered label series at 0.0,
+    so earlier tests in the same process must not make `== {}` assertions
+    order-dependent."""
+    if snap is None:
+        snap = obs_metrics.registry().snapshot()
+    for fam in snap.get("families", []):
+        if fam.get("name") == name:
+            return {
+                tuple(s.get("labels", ())): s.get("value", 0.0)
+                for s in fam.get("series", [])
+                if s.get("value")
+            }
+    return {}
+
+
+# -- digests ------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "digest", [integrity.array_digest, integrity.state_digest],
+    ids=["blake2b", "adler32"],
+)
+def test_digests_deterministic_and_bind_shape_dtype(digest):
+    """Both digest tiers — blake2b (checkpoints) and the adler32 state
+    chain (the per-batch resident-strip plane) — honour the same
+    contract: deterministic, layout-normalising, shape/dtype-binding,
+    and sensitive to any single flipped byte."""
+    rng = np.random.default_rng(0)
+    a = rng.integers(0, 255, (32, 16), dtype=np.uint8)
+    assert digest(a) == digest(a.copy())
+    # a non-contiguous view with the same logical content digests equal
+    # (ascontiguousarray normalises the layout before hashing)
+    assert digest(a[::1]) == digest(a)
+    # same bytes, different shape or dtype: different digest — a reshaped
+    # or recast buffer cannot impersonate the original
+    assert digest(a) != digest(a.reshape(16, 32))
+    assert digest(a) != digest(a.view(np.int8))
+    # one flipped byte flips the digest — everywhere
+    for r in range(a.shape[0]):
+        b = a.copy()
+        b[r, r % a.shape[1]] ^= 0xFF
+        assert digest(a) != digest(b)
+    # the empty array (the final shrinking attestation band) is defined
+    # and stable
+    assert digest(np.empty((0, 16), np.uint8)) == (
+        digest(np.empty((0, 16), np.uint8))
+    )
+
+
+def test_state_digest_rolls_and_separates_boundaries():
+    """The rolling fold the attestation accumulators rely on: folding
+    [A, B] equals digesting them in sequence, differs from [B, A], and
+    from folding a single concatenated array (each fold binds its own
+    shape header, so band boundaries cannot alias)."""
+    rng = np.random.default_rng(3)
+    a = rng.integers(0, 255, (6, 8), dtype=np.uint8)
+    b = rng.integers(0, 255, (4, 8), dtype=np.uint8)
+    ab = integrity.state_hex(
+        integrity.state_add(integrity.state_add(integrity.state_new(), a), b)
+    )
+    ab2 = integrity.state_hex(
+        integrity.state_add(integrity.state_add(integrity.state_new(), a), b)
+    )
+    ba = integrity.state_hex(
+        integrity.state_add(integrity.state_add(integrity.state_new(), b), a)
+    )
+    cat = integrity.state_digest(np.concatenate([a, b], axis=0))
+    assert ab == ab2
+    assert ab != ba
+    assert ab != cat
+
+
+# -- checked frames -----------------------------------------------------------
+
+
+class _RecordingSock:
+    def __init__(self):
+        self.chunks = []
+
+    def sendall(self, data):
+        self.chunks.append(bytes(data))
+
+
+def _frame_bytes(obj, oob=False, checksum=False) -> bytes:
+    sock = _RecordingSock()
+    send_frame(sock, obj, oob=oob, checksum=checksum)
+    return b"".join(sock.chunks)
+
+
+def _recv_raw(raw: bytes):
+    a, b = socket.socketpair()
+    try:
+        a.sendall(raw)
+        a.close()
+        return recv_frame_sized(b)
+    finally:
+        b.close()
+
+
+@pytest.mark.parametrize("oob", [False, True])
+def test_checked_frame_roundtrip_both_shapes(oob, live_metrics):
+    big = np.arange(64 * 64, dtype=np.uint8).reshape(64, 64)
+    raw = _frame_bytes({"id": 3, "x": big}, oob=oob, checksum=True)
+    (word,) = _HEADER.unpack(raw[:8])
+    assert word & _FLAG_CK
+    assert bool(word & _FLAG_OOB) == oob
+    c0 = _counter("gol_integrity_checks_total")
+    obj, nbytes = _recv_raw(raw)
+    assert nbytes == len(raw)
+    assert obj["id"] == 3
+    np.testing.assert_array_equal(obj["x"], big)
+    assert _counter("gol_integrity_checks_total") == c0 + 1
+    assert _labeled("gol_integrity_failures_total") == {}
+
+
+@pytest.mark.parametrize("oob", [False, True])
+def test_checked_frame_flip_refused_before_parse(oob, live_metrics):
+    """Any flipped byte — pickle, sidecar, or the in-header crc word
+    itself — is a loud IntegrityError and the frame is NEVER parsed.
+    This is the corruption class `ChaosProxy.corrupt_sidecar` lands and
+    TCP's own 16-bit checksum can miss."""
+    big = np.arange(64 * 64, dtype=np.uint8).reshape(64, 64)
+    raw = bytearray(_frame_bytes({"x": big}, oob=oob, checksum=True))
+    for pos in (len(raw) // 2, 9):  # a body byte, a crc-word byte
+        flipped = bytearray(raw)
+        flipped[pos] ^= 0x01
+        f0 = _labeled("gol_integrity_failures_total").get(("frame",), 0)
+        with pytest.raises(IntegrityError, match="refusing to parse"):
+            _recv_raw(bytes(flipped))
+        assert _labeled("gol_integrity_failures_total")[("frame",)] == f0 + 1
+    # IntegrityError is a ConnectionError: every transport-failure path
+    # treats the stream as dead
+    assert issubclass(IntegrityError, ConnectionError)
+
+
+def test_checked_frame_crc_rides_in_header():
+    """The crc word sits right behind the length word and ships in the
+    SAME sendall — the latency contract: a receiver that has drained the
+    body never waits on a trailing segment (whose delivery would ride on
+    the sender thread being rescheduled) to verify."""
+    for oob in (False, True):
+        sock = _RecordingSock()
+        send_frame(
+            sock, {"x": np.arange(4096, dtype=np.uint8)},
+            oob=oob, checksum=True,
+        )
+        head = sock.chunks[0]
+        assert len(head) == 12  # length word + crc word, one sendall
+        body = b"".join(sock.chunks[1:])
+        want = integrity.crc_pack(integrity.crc_add(0, body))
+        assert head[8:12] == want
+    # and a checked frame cut off before its crc word is a loud
+    # connection error, never a parse
+    a, b = socket.socketpair()
+    try:
+        a.sendall(_HEADER.pack(_FLAG_CK | 2) + b"xx")
+        a.close()
+        with pytest.raises(ConnectionError, match="peer closed"):
+            recv_frame_sized(b)
+    finally:
+        b.close()
+
+
+def test_checked_frame_fails_old_receivers_loudly():
+    """Both vintages of old receiver refuse a checked frame at the length
+    check — bit 62 rides above MAX_FRAME — never a mis-parse."""
+    raw = _frame_bytes({"x": 1}, checksum=True)
+    (word,) = _HEADER.unpack(raw[:8])
+    # pre-protocol-5 receiver: raw length word
+    assert word > MAX_FRAME
+    # PR 5-era receiver: masks only bit 63, still sees an absurd length
+    assert word & (_FLAG_OOB - 1) > MAX_FRAME
+
+
+def test_server_sends_checked_frames_only_to_advertising_clients():
+    server = RpcServer(port=0)
+    server.register("T.Echo", lambda req: Response(turns_completed=1))
+    server.serve_background()
+
+    def one_call(envelope_extra):
+        sock = socket.create_connection(("127.0.0.1", server.port), timeout=5)
+        try:
+            send_frame(
+                sock,
+                {"id": 0, "method": "T.Echo", "request": Request(),
+                 **envelope_extra},
+            )
+            head = b""
+            while len(head) < 8:
+                head += sock.recv(8 - len(head))
+            (word,) = _HEADER.unpack(head)
+            return word
+        finally:
+            sock.close()
+
+    try:
+        # an old client never advertised "ck": its reply frame is plain
+        assert not one_call({}) & _FLAG_CK
+        # an advertising client gets a checked reply on the same server
+        assert one_call({"ck": 1}) & _FLAG_CK
+    finally:
+        server.stop()
+
+
+def test_client_never_checks_frames_to_old_server():
+    """Old-server skew: replies without the "ck" advertisement keep the
+    client's frames unchecked forever — and with -integrity off the
+    client does not even advertise."""
+    listener = socket.socket()
+    listener.bind(("127.0.0.1", 0))
+    listener.listen(1)
+    port = listener.getsockname()[1]
+    seen = []
+
+    def old_server():
+        conn, _ = listener.accept()
+        with conn:
+            for _ in range(2):
+                head = b""
+                while len(head) < 8:
+                    head += conn.recv(8 - len(head))
+                (word,) = _HEADER.unpack(head)
+                seen.append(word)
+                body = b""
+                length = word & (_FLAG_CK - 1)
+                while len(body) < length:
+                    body += conn.recv(min(1 << 20, length - len(body)))
+                msg = loads_restricted(body)
+                seen.append(msg.get("ck"))
+                # an OLD server's reply: no "ck" (and no "oob") key
+                send_frame(conn, {"id": msg["id"], "result": Response()})
+
+    t = threading.Thread(target=old_server, daemon=True)
+    t.start()
+    client = RpcClient(f"127.0.0.1:{port}", timeout=5)
+    try:
+        client.call("T.X", Request(), timeout=5)
+        integrity.set_enabled(False)
+        client.call("T.X", Request(), timeout=5)
+        assert client._peer_ck is False
+        words, advertised = seen[0::2], seen[1::2]
+        assert all(not w & _FLAG_CK for w in words), (
+            "an old server was sent a checked frame"
+        )
+        # enabled: the client advertises; disabled: it does not
+        assert advertised == [1, None]
+    finally:
+        integrity.set_enabled(True)
+        client.close()
+        listener.close()
+        t.join(timeout=5)
+
+
+def test_negotiated_connection_upgrades_to_checked_both_ways(live_metrics):
+    """Two current peers with -integrity on converge to checked frames in
+    both directions after the first exchange; the check counters move."""
+    server = RpcServer(port=0)
+    server.register("T.Echo", lambda req: Response(world=np.asarray(req.world)))
+    server.serve_background()
+    client = RpcClient(f"127.0.0.1:{server.port}", timeout=5)
+    try:
+        big = np.random.default_rng(3).integers(0, 255, (64, 64), np.uint8)
+        assert client._peer_ck is False
+        client.call("T.Echo", Request(world=big), timeout=5)
+        assert client._peer_ck is True  # reply advertised: upgraded
+        c0 = _counter("gol_integrity_checks_total")
+        r = client.call("T.Echo", Request(world=big), timeout=5)
+        np.testing.assert_array_equal(r.world, big)
+        # request verified by the server AND reply verified by the client
+        assert _counter("gol_integrity_checks_total") >= c0 + 2
+        assert _labeled("gol_integrity_failures_total") == {}
+    finally:
+        client.close()
+        server.stop()
+
+
+# -- halo cross-attestation ---------------------------------------------------
+
+
+def _split_bounds(h, n):
+    """Contiguous row strips, uneven like the broker's _split."""
+    base, extra = divmod(h, n)
+    bounds, s = [], 0
+    for i in range(n):
+        e = s + base + (1 if i < extra else 0)
+        bounds.append((s, e))
+        s = e
+    return bounds
+
+
+def _attest_all(board, bounds, k):
+    """Run every strip through strip_step_batch(attest=True) with the
+    wrapped neighbour halos the broker would relay."""
+    h = board.shape[0]
+    out = []
+    for s, e in bounds:
+        top = board[np.arange(s - k, s) % h]
+        bottom = board[np.arange(e, e + k) % h]
+        strip, counts, att_top, att_bottom = rpc_worker.strip_step_batch(
+            board[s:e].copy(), top, bottom, k, attest=True
+        )
+        out.append((strip, counts, att_top, att_bottom))
+    return out
+
+
+@pytest.mark.parametrize("h,n,k", [(31, 3, 3), (23, 4, 2), (16, 1, 4)])
+def test_attestation_bands_agree_across_uneven_splits(h, n, k):
+    """The redundant-boundary-band math: worker i's per-step top-band
+    digests equal worker i-1's bottom-band digests (wraparound included,
+    single-worker self-agreement included), strip heights uneven."""
+    rng = np.random.default_rng(h * 10 + n)
+    board = np.where(rng.random((h, 12)) < 0.4, 255, 0).astype(np.uint8)
+    bounds = _split_bounds(h, n)
+    assert len({e - s for s, e in bounds}) > 1 or n == 1  # genuinely uneven
+    results = _attest_all(board, bounds, k)
+    for i in range(n):
+        up = (i - 1) % n
+        assert results[i][2] == results[up][3], (
+            f"top bands of strip {i} disagree with bottom bands of {up}"
+        )
+        assert results[i][2] and isinstance(results[i][2], str)
+    # and the strips really advanced k turns (the bands attested REAL rows)
+    want = board.copy()
+    for _ in range(k):
+        want = vector_step(want)
+    for (s, e), (strip, _c, _t, _b) in zip(bounds, results):
+        np.testing.assert_array_equal(strip, want[s:e])
+
+
+def test_attestation_catches_wrong_compute():
+    """A flipped cell near one strip's boundary breaks band agreement
+    with the neighbour that shares that boundary in the SAME batch — the
+    ≤K-turn detection bound the broker's cross-check relies on. A cell
+    outside the other boundary's dependency cone leaves those bands
+    untouched (the cone math is exact, not fuzzy)."""
+    rng = np.random.default_rng(7)
+    board = np.where(rng.random((24, 12)) < 0.4, 255, 0).astype(np.uint8)
+    bounds = _split_bounds(24, 3)
+    k = 3
+    clean = _attest_all(board, bounds, k)
+    # strip 1 steps from a corrupted copy of its rows while its
+    # neighbours step from the clean board — the wrong-compute shape
+    h = board.shape[0]
+    s, e = bounds[1]
+    corrupt = board[s:e].copy()
+    corrupt[0, 5] ^= 0xFF  # first row: inside the TOP boundary's cone
+    top = board[np.arange(s - k, s) % h]
+    bottom = board[np.arange(e, e + k) % h]
+    _strip, _c, att_top, att_bottom = rpc_worker.strip_step_batch(
+        corrupt, top, bottom, k, attest=True
+    )
+    # the broker's cross-check: worker 1's top bands vs worker 0's bottom
+    # bands must now DISAGREE — the corruption is caught this batch
+    assert att_top != clean[0][3]
+    # the bottom boundary sits 8 rows away: k=3 steps of light cone never
+    # reach it, so those bands still agree with worker 2's clean top
+    assert att_bottom == clean[1][3]
+    assert clean[2][2] == att_bottom
+
+
+def test_worker_strip_step_reply_carries_verifiable_digests(clean_faults):
+    service = rpc_worker.WorkerService(server=None)
+    rng = np.random.default_rng(11)
+    strip = np.where(rng.random((8, 16)) < 0.4, 255, 0).astype(np.uint8)
+    service.strip_start(Request(world=strip.copy(), worker=0, initial_turn=0))
+    halos = np.zeros((4, 16), np.uint8)
+    res = service.strip_step(
+        Request(world=halos, turns=2, worker=0, initial_turn=0)
+    )
+    d = res.digests
+    assert isinstance(d, dict)
+    assert d["pre"] == integrity.state_digest(strip)
+    assert d["strip"] == integrity.state_digest(service._strip)
+    assert d["edges"] == integrity.state_digest(res.edges)
+    assert d["attest_top"] and d["attest_bottom"]
+    # -integrity off: no digests are computed or shipped (the skew shape
+    # an old worker would produce — the broker must tolerate it)
+    integrity.set_enabled(False)
+    res2 = service.strip_step(
+        Request(world=halos, turns=2, worker=0, initial_turn=2)
+    )
+    assert res2.digests is None
+
+
+def test_fault_point_corrupt_flips_exactly_one_byte(clean_faults):
+    faults.configure("worker.strip_corrupt:corrupt:2:5")
+    arr = np.zeros((4, 4), np.uint8)
+    faults.fault_point("worker.strip_corrupt", target=arr)  # hit 1: no-op
+    assert not arr.any()
+    faults.fault_point("worker.strip_corrupt", target=arr)  # hit 2: fires
+    assert arr.reshape(-1)[5] == 0xFF
+    assert int(np.count_nonzero(arr)) == 1
+    faults.fault_point("worker.strip_corrupt", target=arr)  # hit 3: no-op
+    assert int(np.count_nonzero(arr)) == 1
+
+
+# -- resident cluster: corruption detected, recovered, bit-identical ----------
+
+
+def _rand_board(h, w, seed):
+    rng = np.random.default_rng(seed)
+    return np.where(rng.random((h, w)) < 0.4, 255, 0).astype(np.uint8)
+
+
+def _oracle(board, turns):
+    want = board.copy()
+    for _ in range(turns):
+        want = vector_step(want)
+    return want
+
+
+def _run_backend(backend, board, turns, threads):
+    try:
+        return backend.run(
+            Request(
+                world=board, turns=turns, threads=threads,
+                image_width=board.shape[1], image_height=board.shape[0],
+            )
+        )
+    finally:
+        backend.close()
+
+
+def test_resident_inplace_strip_corruption_detected_bit_identical(
+    clean_faults, live_metrics
+):
+    """Acceptance: a worker's RESIDENT strip is corrupted in place
+    mid-run (the `corrupt` fault action at `worker.strip_corrupt` — one
+    byte, a valid cell value, invisible without digests). The pre-batch
+    digest breaks the broker's committed chain on the very next
+    StripStep, the worker is routed through the loss/rebuild path, and
+    the finished run is bit-identical to the oracle."""
+    servers = [rpc_worker.serve(port=0) for _ in range(3)]
+    addrs = [f"127.0.0.1:{s.port}" for s, _ in servers]
+    board = _rand_board(48, 48, seed=21)
+    turns = 600
+    faults.configure("worker.strip_corrupt:corrupt:30:100")
+    try:
+        backend = WorkersBackend(
+            addrs, wire="resident", halo_depth=4, sync_interval=64,
+            rpc_deadline=5.0, probe_interval=0.2,
+        )
+        res = _run_backend(backend, board, turns, threads=3)
+        assert res.turns_completed == turns
+        np.testing.assert_array_equal(res.world, _oracle(board, turns))
+        fails = _labeled("gol_integrity_failures_total")
+        assert fails.get(("strip",), 0) >= 1, (
+            "the in-place corruption was never detected"
+        )
+        assert _counter("gol_worker_lost_total") >= 1
+    finally:
+        for s, _svc in servers:
+            s.stop()
+
+
+def test_resident_inplace_corruption_is_silent_without_integrity(
+    clean_faults, live_metrics
+):
+    """The undefended half of the contract: the SAME fault against
+    ``-integrity off`` completes the run with a silently-wrong board —
+    no detection, no loss, no error. This is the exposure the issue
+    names; the test pins it so the defended test above means something."""
+    integrity.set_enabled(False)
+    servers = [rpc_worker.serve(port=0) for _ in range(3)]
+    addrs = [f"127.0.0.1:{s.port}" for s, _ in servers]
+    board = _rand_board(48, 48, seed=22)
+    turns = 600
+    faults.configure("worker.strip_corrupt:corrupt:30:100")
+    try:
+        backend = WorkersBackend(
+            addrs, wire="resident", halo_depth=4, sync_interval=64,
+            rpc_deadline=5.0, probe_interval=0.2,
+        )
+        res = _run_backend(backend, board, turns, threads=3)
+        assert res.turns_completed == turns
+        assert not np.array_equal(res.world, _oracle(board, turns)), (
+            "the corruption did not survive — the fault harness is not "
+            "expressing the silent-corruption class"
+        )
+        assert _labeled("gol_integrity_failures_total") == {}
+        assert _counter("gol_worker_lost_total") == 0
+    finally:
+        for s, _svc in servers:
+            s.stop()
+
+
+def test_resident_sidecar_bitflip_detected_bit_identical(live_metrics):
+    """Acceptance: one bit flipped inside an out-of-band ndarray sidecar
+    on the resident wire (ChaosProxy corrupt_sidecar — the fault PR 5's
+    proxy refused to land). The checked frame refuses to parse, the
+    worker is treated as lost, readmitted through the now-clean proxy,
+    and the run finishes bit-identical."""
+    servers = [rpc_worker.serve(port=0) for _ in range(3)]
+    proxy = ChaosProxy(f"127.0.0.1:{servers[1][0].port}", corrupt_sidecar=20)
+    addrs = [
+        f"127.0.0.1:{servers[0][0].port}",
+        proxy.address,
+        f"127.0.0.1:{servers[2][0].port}",
+    ]
+    # 128 columns: halo/edge frames are 8*128 = 1024 B >= the out-of-band
+    # threshold, so steady-state StripStep traffic carries raw sidecars
+    board = _rand_board(96, 128, seed=23)
+    turns = 800
+    try:
+        backend = WorkersBackend(
+            addrs, wire="resident", halo_depth=4, sync_interval=64,
+            rpc_deadline=2.0, probe_interval=0.2,
+        )
+        res = _run_backend(backend, board, turns, threads=3)
+        assert res.turns_completed == turns
+        np.testing.assert_array_equal(res.world, _oracle(board, turns))
+        fails = _labeled("gol_integrity_failures_total")
+        assert fails.get(("frame",), 0) >= 1, (
+            "the sidecar flip was never caught by a frame checksum"
+        )
+        assert _counter("gol_worker_lost_total") >= 1
+    finally:
+        proxy.close()
+        for s, _svc in servers:
+            s.stop()
+
+
+# -- verified checkpoints -----------------------------------------------------
+
+
+def test_checkpoint_digest_roundtrip_and_metadata_binding(tmp_path):
+    board = _rand_board(12, 9, seed=1)
+    p = save_checkpoint(tmp_path / "ck", board, 17, CONWAY)
+    got, turn, rule = load_verified_checkpoint(p)
+    np.testing.assert_array_equal(got, board)
+    assert turn == 17 and rule.rulestring == CONWAY.rulestring
+    # the lenient loader still reads v2 files (forward-compatible keys)
+    got2, turn2, _rule2 = load_checkpoint(p)
+    np.testing.assert_array_equal(got2, board)
+    assert turn2 == 17
+    # the digest binds every metadata field, not just the board bytes
+    d = checkpoint_digest(board, 17, CONWAY.rulestring)
+    assert checkpoint_digest(board, 18, CONWAY.rulestring) != d
+    assert checkpoint_digest(board, 17, "B36/S23") != d
+    assert checkpoint_digest(board.reshape(9, 12), 17, CONWAY.rulestring) != d
+
+
+def test_checkpoint_typed_errors_cover_every_corruption(tmp_path, live_metrics):
+    """Every way an npz can be wrong is a CheckpointError with a kind and
+    an actionable message — never a raw zipfile/KeyError traceback (the
+    satellite: `-resume` with garbage used to surface one)."""
+    board = _rand_board(8, 8, seed=2)
+
+    def expect(path, kind, match):
+        f0 = _labeled("gol_ckpt_verify_total").get(("fail",), 0)
+        with pytest.raises(CheckpointError, match=match) as ei:
+            load_verified_checkpoint(path)
+        assert ei.value.kind == kind
+        assert _labeled("gol_ckpt_verify_total")[("fail",)] == f0 + 1
+
+    garbage = tmp_path / "garbage.npz"
+    garbage.write_bytes(b"this is not an npz at all")
+    expect(garbage, "unreadable", "not a readable checkpoint")
+
+    good = save_checkpoint(tmp_path / "good", board, 5, CONWAY)
+    truncated = tmp_path / "truncated.npz"
+    truncated.write_bytes(good.read_bytes()[: good.stat().st_size // 2])
+    expect(truncated, "unreadable", "truncated or corrupt")
+
+    fields = tmp_path / "fields.npz"
+    np.savez(fields, board=board)
+    expect(fields, "truncated", "missing checkpoint field")
+
+    packed = save_packed_checkpoint(
+        tmp_path / "packed", np.zeros((1, 8), np.uint32), 5
+    )
+    expect(packed, "format", "packed-bitboard")
+
+    legacy = tmp_path / "legacy.npz"
+    np.savez(
+        legacy, board=board, turn=np.int64(5),
+        rulestring=np.str_(CONWAY.rulestring),
+    )
+    expect(legacy, "unverified", "no integrity digest")
+
+    forged = tmp_path / "forged.npz"
+    np.savez(
+        forged, board=board, turn=np.int64(5),
+        rulestring=np.str_(CONWAY.rulestring), format_version=np.int64(2),
+        digest=np.str_("0" * 32),
+    )
+    expect(forged, "digest", "failed digest verification")
+
+    # a verifying load counts on the ok side
+    ok0 = _labeled("gol_ckpt_verify_total").get(("ok",), 0)
+    load_verified_checkpoint(good)
+    assert _labeled("gol_ckpt_verify_total")[("ok",)] == ok0 + 1
+
+
+def test_ckpt_generation_rotation_and_resume_fallback(tmp_path):
+    board = _rand_board(8, 8, seed=3)
+    base = tmp_path / "auto"
+    # three auto-checkpoint writes with keep=3, the broker's sequence:
+    # rotate THEN write-current (tmp+rename)
+    for turn in (10, 20, 30):
+        tmp = base.with_name("auto.tmp")
+        written = save_checkpoint(tmp, board, turn, CONWAY)
+        rotate_generations(base, keep=3)
+        written.replace(npz_path(base))
+    assert generation_path(base, 0) == npz_path(base)
+    for gen, turn in ((0, 30), (1, 20), (2, 10)):
+        _b, t, _r = load_verified_checkpoint(generation_path(base, gen))
+        assert t == turn
+    # newest verifies: fallback returns gen 0
+    got = load_resume_checkpoint(base, keep=3)
+    assert (got[1], got[3]) == (30, 0)
+    # corrupt the newest: fallback walks to gen 1
+    npz_path(base).write_bytes(b"scribble")
+    got = load_resume_checkpoint(base, keep=3)
+    assert (got[1], got[3]) == (20, 1)
+    # keep=1 refuses instead of silently reading an older generation
+    with pytest.raises(CheckpointError) as ei:
+        load_resume_checkpoint(base, keep=1)
+    assert ei.value.kind == "exhausted"
+    # every generation bad: exhausted, listing each attempt
+    generation_path(base, 1).write_bytes(b"scribble")
+    generation_path(base, 2).unlink()
+    with pytest.raises(CheckpointError, match="not found") as ei:
+        load_resume_checkpoint(base, keep=3)
+    assert ei.value.kind == "exhausted"
+    assert str(ei.value).count("[unreadable]") == 2
+
+
+def test_resume_cli_refuses_unverified_loudly(tmp_path, capsys):
+    """The broker and controller `-resume` surfaces turn a bad checkpoint
+    into a parser error (typed message, exit 2) BEFORE anything starts —
+    not a mid-setup traceback."""
+    from gol_distributed_final_tpu.__main__ import main as controller_main
+    from gol_distributed_final_tpu.rpc.broker import main as broker_main
+
+    bad = tmp_path / "bad.npz"
+    bad.write_bytes(b"zip? no")
+    with pytest.raises(SystemExit) as ei:
+        broker_main(["-backend", "workers", "-workers", "127.0.0.1:1",
+                     "-resume", str(bad)])
+    assert ei.value.code == 2
+    assert "not a readable checkpoint" in capsys.readouterr().err
+    with pytest.raises(SystemExit) as ei:
+        controller_main(["-resume", str(bad)])
+    assert ei.value.code == 2
+    assert "not a readable checkpoint" in capsys.readouterr().err
+    # a pre-integrity file is refused just as loudly (unverified kind)
+    legacy = tmp_path / "legacy.npz"
+    np.savez(
+        legacy, board=np.zeros((4, 4), np.uint8), turn=np.int64(1),
+        rulestring=np.str_(CONWAY.rulestring),
+    )
+    with pytest.raises(SystemExit):
+        broker_main(["-backend", "workers", "-workers", "127.0.0.1:1",
+                     "-resume", str(legacy)])
+    assert "no integrity digest" in capsys.readouterr().err
+
+
+def test_broker_ckpt_keep_flag_validation(capsys):
+    from gol_distributed_final_tpu.rpc.broker import main as broker_main
+
+    with pytest.raises(SystemExit):
+        broker_main(["-backend", "workers", "-workers", "127.0.0.1:1",
+                     "-ckpt-keep", "0"])
+    assert "-ckpt-keep must be >= 1" in capsys.readouterr().err
+    with pytest.raises(SystemExit):
+        broker_main(["-backend", "tpu", "-ckpt-keep", "3"])
+    assert "does nothing here" in capsys.readouterr().err
+
+
+# -- observability surfaces ---------------------------------------------------
+
+
+def test_watch_renders_integrity_panel(live_metrics):
+    from gol_distributed_final_tpu.obs import instruments as ins
+    from gol_distributed_final_tpu.obs.watch import render_status
+
+    def payload():
+        return {
+            "role": "broker", "pid": 1, "metrics_enabled": True,
+            "metrics": obs_metrics.registry().snapshot(),
+        }
+
+    # all-zero registry: no INTEGRITY panel noise
+    assert "INTEGRITY" not in render_status("b", payload())
+    ins.INTEGRITY_CHECKS_TOTAL.inc(500)
+    ins.CKPT_VERIFY_TOTAL.labels("ok").inc()
+    frame = render_status("b", payload())
+    assert "INTEGRITY" in frame
+    assert "checks 500" in frame
+    assert "ckpt verify ok 1" in frame
+    assert "CORRUPTION CAUGHT" not in frame
+    ins.INTEGRITY_FAILURES_TOTAL.labels("strip").inc()
+    frame = render_status("b", payload())
+    assert "CORRUPTION CAUGHT" in frame
+    assert "strip 1" in frame
+
+
+def test_integrity_lint_and_readme_section():
+    from gol_distributed_final_tpu.obs.lint import (
+        missing_readme_sections,
+        undocumented_integrity_metrics,
+    )
+
+    assert undocumented_integrity_metrics() == []
+    assert missing_readme_sections() == []
+
+
+# -- live subprocess chaos (slow: scripts/check --integrity) ------------------
+
+
+def _status_counter(address: str, name: str, worker=False) -> dict:
+    """{labels: value} for one family out of a live Status payload."""
+    from gol_distributed_final_tpu.obs.status import fetch_status
+
+    payload = fetch_status(address, worker=worker, timeout=5.0)
+    return _labeled(name, payload.get("metrics") or {})
+
+
+def _run_live_cluster(faulted_worker_target, other_ports, turns):
+    """Drive a spawned resident cluster to completion and return
+    (result, broker_address). The caller owns process/proxy cleanup."""
+    from gol_distributed_final_tpu import Params
+    from gol_distributed_final_tpu.rpc.client import RemoteBroker
+    from test_chaos import _read_board_64
+
+    broker = _spawn(
+        "gol_distributed_final_tpu.rpc.broker",
+        "-port", "0", "-backend", "workers", "-metrics",
+        "-wire", "resident", "-halo-depth", "8", "-sync-interval", "64",
+        "-workers",
+        ",".join(
+            [faulted_worker_target]
+            + [f"127.0.0.1:{p}" for p in other_ports]
+        ),
+        "-rpc-deadline", "5", "-probe-interval", "0.2",
+    )
+    address = f"127.0.0.1:{_wait_listening(broker)}"
+    remote = RemoteBroker(address, timeout=30.0)
+    result = {}
+    t = threading.Thread(
+        target=lambda: result.update(r=remote.run(
+            Params(turns=turns, threads=3, image_width=64, image_height=64),
+            _read_board_64(),
+        ))
+    )
+    t.start()
+    try:
+        t.join(timeout=300)
+        assert not t.is_alive(), "run hung after the corruption"
+    finally:
+        if t.is_alive():
+            remote.quit()
+            t.join(timeout=30)
+        remote.close()
+    return result["r"], address, broker
+
+
+@pytest.mark.slow
+def test_chaos_sidecar_bitflip_live_bit_identical():
+    """Acceptance, live: a ChaosProxy flips ONE BIT inside an out-of-band
+    sidecar between the broker and a worker mid-run. The checked frame is
+    refused before parsing (gol_integrity_failures_total{frame} on
+    whichever peer received it), the worker is dropped and readmitted
+    through the now-clean path, and the finished board is bit-identical
+    to an uninterrupted oracle run."""
+    from test_chaos import _oracle_64
+
+    turns = 3000
+    workers = [
+        _spawn("gol_distributed_final_tpu.rpc.worker", "-port", "0",
+               "-metrics")
+        for _ in range(3)
+    ]
+    broker = proxy = None
+    try:
+        ports = [_wait_listening(w) for w in workers]
+        proxy = ChaosProxy(f"127.0.0.1:{ports[0]}", corrupt_sidecar=30)
+        result, address, broker = _run_live_cluster(
+            proxy.address, ports[1:], turns
+        )
+        assert result.turns_completed == turns
+        np.testing.assert_array_equal(result.world, _oracle_64(turns))
+        broker_fails = _status_counter(
+            address, "gol_integrity_failures_total"
+        ).get(("frame",), 0)
+        worker_fails = _status_counter(
+            f"127.0.0.1:{ports[0]}", "gol_integrity_failures_total",
+            worker=True,
+        ).get(("frame",), 0)
+        assert broker_fails + worker_fails >= 1, (
+            "no frame checksum failure was recorded anywhere"
+        )
+        lost = _status_counter(address, "gol_worker_lost_total")
+        assert sum(lost.values()) >= 1
+        readmitted = _status_counter(address, "gol_worker_readmitted_total")
+        assert sum(readmitted.values()) >= 1, (
+            "the corrupted-path worker was never readmitted"
+        )
+    finally:
+        if proxy is not None:
+            proxy.close()
+        _kill_all([*workers, broker])
+
+
+@pytest.mark.slow
+def test_chaos_inplace_strip_corruption_live_bit_identical(monkeypatch):
+    """Acceptance, live: a worker subprocess corrupts its RESIDENT strip
+    in place mid-run (GOL_FAULT_POINTS corrupt action — the fault only
+    that process sees). The broker's digest chain catches it within one
+    batch (gol_integrity_failures_total{strip}), routes it through
+    quarantine/rebuild, and the run finishes bit-identical to the
+    oracle."""
+    from test_chaos import _oracle_64
+
+    turns = 3000
+    monkeypatch.setenv(
+        "GOL_FAULT_POINTS", "worker.strip_corrupt:corrupt:25:300"
+    )
+    faulted = _spawn(
+        "gol_distributed_final_tpu.rpc.worker", "-port", "0", "-metrics"
+    )
+    monkeypatch.delenv("GOL_FAULT_POINTS")
+    workers = [faulted] + [
+        _spawn("gol_distributed_final_tpu.rpc.worker", "-port", "0",
+               "-metrics")
+        for _ in range(2)
+    ]
+    broker = None
+    try:
+        ports = [_wait_listening(w) for w in workers]
+        result, address, broker = _run_live_cluster(
+            f"127.0.0.1:{ports[0]}", ports[1:], turns
+        )
+        assert result.turns_completed == turns
+        np.testing.assert_array_equal(result.world, _oracle_64(turns))
+        fails = _status_counter(address, "gol_integrity_failures_total")
+        assert fails.get(("strip",), 0) >= 1, (
+            "the in-place corruption was never detected by the chain"
+        )
+        lost = _status_counter(address, "gol_worker_lost_total")
+        assert sum(lost.values()) >= 1
+    finally:
+        _kill_all([*workers, broker])
