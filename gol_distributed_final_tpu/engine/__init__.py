@@ -8,11 +8,15 @@ from .checkpoint import (
     save_packed_checkpoint,
 )
 from .engine import Engine, RunResult, Snapshot
+from .sessions import Session, SessionRejected, SessionTable
 
 __all__ = [
     "CheckpointError",
     "Engine",
     "RunResult",
+    "Session",
+    "SessionRejected",
+    "SessionTable",
     "Snapshot",
     "load_checkpoint",
     "load_packed_checkpoint",

@@ -299,6 +299,14 @@ class Engine:
             # loop initialised must still take effect (they are consumed /
             # cleared when this run ends)
 
+        # pre-run HBM baseline, ONCE PER RUN: even a run that dies in its
+        # first chunk leaves the pre-run occupancy on the gauges, and the
+        # first turn-chunk sample then shows the step's delta. This lives
+        # HERE rather than in ops/auto.py because tier selection is now
+        # cached per (rule, shape) — a repeat-geometry run would otherwise
+        # inherit the previous run's end-state as its "baseline"
+        _device.sample_hbm()
+
         # a multi-host (SPMD) run: every rank executes this same loop and
         # every jax collective must be issued in the same order on every
         # rank — so chunk growth must not depend on rank-local wall clocks

@@ -153,6 +153,31 @@ STRIP_RESYNC_TOTAL = _R.counter(
     "recovery.",
 )
 
+# -- multi-universe serving (engine/sessions.py, rpc/broker.py scheduler) ---
+
+SESSIONS_ACTIVE = _R.gauge(
+    "gol_sessions_active",
+    "Universes currently packed (or pending admission) in this process's "
+    "device-batched session table.",
+)
+SESSIONS_ADMITTED_TOTAL = _R.counter(
+    "gol_sessions_admitted_total",
+    "Sessions admitted into the batched session table since start.",
+)
+SESSIONS_REJECTED_TOTAL = _R.counter(
+    "gol_sessions_rejected_total",
+    "Session admissions refused, by reason: 'capacity' (table full), "
+    "'geometry' (board shape differs from the batch's), 'rule' (rule "
+    "differs from the batch's), 'turns' (non-positive budget), 'tag' "
+    "(client session tag already in use).",
+    labelnames=("reason",),
+)
+SESSION_TURNS_TOTAL = _R.counter(
+    "gol_session_turns_total",
+    "Universe-turns evolved by the batched session driver (each k-turn "
+    "batched dispatch adds k x active universes).",
+)
+
 # -- data integrity (rpc/integrity.py: checked frames, attestation,
 #    verified checkpoints) ---------------------------------------------------
 
@@ -219,7 +244,9 @@ AUTO_CHECKPOINT_TOTAL = _R.counter(
 OPS_PLANE_SELECTED_TOTAL = _R.counter(
     "gol_ops_plane_selected_total",
     "Automatic data-plane routing decisions, by selected tier "
-    "(bitplane / roll_stencil / pallas_bit_step / packed_xla_step).",
+    "(bitplane / roll_stencil / pallas_bit_step / packed_xla_step, plus "
+    "the batched family's batch_bitplane / batch_roll_stencil). Cached "
+    "per (rule, shape): counts DECISIONS, not admissions.",
     labelnames=("plane",),
 )
 COMPILE_CACHE_REQUESTS_TOTAL = _R.counter(

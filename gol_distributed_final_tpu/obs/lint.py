@@ -70,6 +70,7 @@ _REQUIRED_SECTIONS = (
     "Fault tolerance",
     "Wire modes",
     "Integrity",
+    "Sessions",
 )
 
 # the wire data-plane metric families (rpc/protocol.py frames + the
@@ -133,6 +134,26 @@ def undocumented_integrity_metrics(readme_path=None) -> List[str]:
     return sorted(n for n in _INTEGRITY_METRIC_NAMES if n not in section)
 
 
+# the multi-universe serving metric families (engine/sessions.py +
+# rpc/broker.SessionScheduler): these must be documented in the README's
+# "Sessions" section specifically — the operator contract for the
+# batched serving surface (admission control, capacity refusals)
+_SESSION_METRIC_NAMES = (
+    "gol_sessions_active",
+    "gol_sessions_admitted_total",
+    "gol_sessions_rejected_total",
+    "gol_session_turns_total",
+)
+
+
+def undocumented_session_metrics(readme_path=None) -> List[str]:
+    """Session metric names missing from the README's "Sessions" section
+    specifically (the wire/device-table posture: a name mentioned
+    elsewhere in the file does not count as documented here)."""
+    section = _readme_section(readme_path, "## Sessions")
+    return sorted(n for n in _SESSION_METRIC_NAMES if n not in section)
+
+
 def undocumented_wire_metrics(readme_path=None) -> List[str]:
     """Wire data-plane metric names missing from the README's
     "Wire modes" section specifically (the device-table posture: a name
@@ -183,6 +204,12 @@ def main(argv=None) -> int:
             "section:",
             "integrity-metric lint ok: every integrity metric is in the "
             "Integrity section",
+        ),
+        (
+            undocumented_session_metrics,
+            "session metrics missing from README.md's Sessions section:",
+            "session-metric lint ok: every session metric is in the "
+            "Sessions section",
         ),
         (
             missing_readme_sections,

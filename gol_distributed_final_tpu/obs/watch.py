@@ -150,6 +150,37 @@ def _wire_lines(snap: dict) -> List[str]:
     return out
 
 
+def _session_lines(snap: dict) -> List[str]:
+    """The multi-universe serving column (engine/sessions.py +
+    rpc/broker.SessionScheduler): universes currently batched, admissions
+    and refusals (by reason — a nonzero 'capacity' stream means traffic is
+    hitting the -session-capacity bound), and universe-turns served. A
+    broker that never serves sessions renders nothing."""
+    active = _scalar(snap, "gol_sessions_active")
+    admitted = _scalar(snap, "gol_sessions_admitted_total")
+    rejected = _series_map(snap, "gol_sessions_rejected_total")
+    turns = _scalar(snap, "gol_session_turns_total")
+    total_rejected = sum(s.get("value") or 0 for s in rejected.values())
+    if not active and not admitted and not total_rejected and not turns:
+        return []
+    out = ["SESSIONS (multi-universe)"]
+    line = (
+        f"  active {int(active or 0):,}   admitted {int(admitted or 0):,}"
+        f"   rejected {int(total_rejected)}"
+    )
+    if total_rejected:
+        reasons = ", ".join(
+            f"{(labels[0] if labels else '?')} {int(s.get('value') or 0)}"
+            for labels, s in sorted(rejected.items())
+            if s.get("value")
+        )
+        line += f"  ({reasons})"
+    out.append(line)
+    if turns:
+        out.append(f"  universe-turns served {int(turns):,}")
+    return out
+
+
 def _worker_lines(payload: dict) -> List[str]:
     """The broker's roster health column (WorkersBackend.worker_health)
     plus the fault-tolerance counters: who is connected, who is lost and
@@ -307,6 +338,7 @@ def render_status(
         _throughput_lines(snap, turns_rate),
         _rpc_lines(snap),
         _wire_lines(snap),
+        _session_lines(snap),
         _integrity_lines(snap),
         _worker_lines(payload),
         _compile_lines(snap),

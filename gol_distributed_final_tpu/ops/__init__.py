@@ -1,5 +1,5 @@
-from .stencil import ALIVE, DEAD, neighbour_counts, step, step_n
-from .reduce import alive_count, alive_cells
+from .stencil import ALIVE, DEAD, neighbour_counts, step, step_n, step_n_batch
+from .reduce import alive_count, alive_count_batch, alive_cells
 
 __all__ = [
     "ALIVE",
@@ -7,6 +7,8 @@ __all__ = [
     "neighbour_counts",
     "step",
     "step_n",
+    "step_n_batch",
     "alive_count",
+    "alive_count_batch",
     "alive_cells",
 ]

@@ -32,28 +32,76 @@ def choose_word_axis(shape: tuple[int, int]) -> Optional[int]:
     return None
 
 
+# (rulestring, shape) -> the selected plane (or None). Selection is pure
+# in its inputs, so the FIRST call per key does the work — the HBM
+# baseline sample and the tier-selection counter bump — and every later
+# call is a dict hit. Before this cache, auto_plane sampled HBM and
+# bumped the gauge on EVERY call: a hot serving loop admitting thousands
+# of sessions per second paid a device memory_stats round-trip per
+# universe and skewed the tier counter from "routing decisions" into
+# "admissions" (ISSUE 7 satellite).
+_PLANE_CACHE: dict = {}
+_BATCH_PLANE_CACHE: dict = {}
+
+
+def _note_selection(tier: str) -> None:
+    """One selection event: an HBM sample at decision time plus the tier
+    counter a Status snapshot shows routing decisions on. The PER-RUN
+    baseline guarantee lives in Engine.run (which samples at every run
+    start regardless of this cache); this sample only adds the
+    first-decision-per-geometry data point."""
+    _device.sample_hbm()
+    _ins.OPS_PLANE_SELECTED_TOTAL.labels(tier).inc()
+
+
 def auto_plane(rule, shape: tuple[int, int]):
     """The fastest correct single-device data plane (ops/plane.py interface)
     for this rule/geometry, or None if only the roll stencil applies.
 
     Unlike the legacy ``auto_step_n_fn`` (which pack/unpacks per call), a
     plane keeps the board bit-packed across chunk dispatches — the engine's
-    hot loop does no representation changes at all."""
-    # baseline HBM reading at tier-selection time (run start): even a run
-    # that dies in its first chunk leaves the pre-run occupancy on the
-    # gauges, and the first turn-chunk sample then shows the step's delta
-    _device.sample_hbm()
+    hot loop does no representation changes at all. Decisions are cached
+    per (rule, shape): repeated admissions of the same geometry cost a
+    dict hit, not an HBM sample + counter bump per universe."""
+    key = (rule.rulestring, shape)
+    if key in _PLANE_CACHE:
+        return _PLANE_CACHE[key]
     word_axis = choose_word_axis(shape)
     if word_axis is None:
-        # the caller falls back to the roll stencil; counted so a Status
-        # snapshot shows WHICH tier runs are landing on (obs/)
-        _ins.OPS_PLANE_SELECTED_TOTAL.labels("roll_stencil").inc()
-        return None
+        _note_selection("roll_stencil")
+        plane = None
+    else:
+        from .plane import BitPlane
 
-    from .plane import BitPlane
+        _note_selection("bitplane")
+        plane = BitPlane(rule, word_axis)
+    _PLANE_CACHE[key] = plane
+    return plane
 
-    _ins.OPS_PLANE_SELECTED_TOTAL.labels("bitplane").inc()
-    return BitPlane(rule, word_axis)
+
+def auto_batch_plane(rule, shape: tuple[int, int]):
+    """The fastest correct BATCHED data plane (ops/batched.py interface)
+    for this per-universe rule/geometry: the batched bitboard family for
+    32-divisible boards (pallas batch-grid kernel on TPU under the
+    per-universe VMEM gate, vmapped XLA bitboard otherwise), the vmapped
+    roll stencil for every other geometry. Always returns a plane —
+    the byte tier handles everything. Same once-per-decision caching as
+    ``auto_plane``: a session table admitting per universe never pays
+    per-call telemetry."""
+    key = (rule.rulestring, shape)
+    if key in _BATCH_PLANE_CACHE:
+        return _BATCH_PLANE_CACHE[key]
+    from .batched import BatchBitPlane, BatchBytePlane
+
+    word_axis = choose_word_axis(shape)
+    if word_axis is None:
+        _note_selection("batch_roll_stencil")
+        plane = BatchBytePlane(rule)
+    else:
+        _note_selection("batch_bitplane")
+        plane = BatchBitPlane(rule, word_axis)
+    _BATCH_PLANE_CACHE[key] = plane
+    return plane
 
 
 def auto_step_n_fn(rule, shape: tuple[int, int]) -> Optional[Callable]:
@@ -61,19 +109,18 @@ def auto_step_n_fn(rule, shape: tuple[int, int]) -> Optional[Callable]:
 
     Legacy per-call pack/evolve/unpack form of ``auto_plane`` — same layout
     policy, kept for callers that want a plain step function."""
-    _device.sample_hbm()  # pre-run HBM baseline, as in auto_plane
     word_axis = choose_word_axis(shape)
     if word_axis is None:
-        _ins.OPS_PLANE_SELECTED_TOTAL.labels("roll_stencil").inc()
+        _note_selection("roll_stencil")
         return None
 
     if jax.devices()[0].platform == "tpu":
         from .pallas_stencil import pallas_bit_step_n_fn
 
-        _ins.OPS_PLANE_SELECTED_TOTAL.labels("pallas_bit_step").inc()
+        _note_selection("pallas_bit_step")
         return pallas_bit_step_n_fn(word_axis=word_axis, interpret=False, rule=rule)
 
     from .bitpack import packed_step_n_fn
 
-    _ins.OPS_PLANE_SELECTED_TOTAL.labels("packed_xla_step").inc()
+    _note_selection("packed_xla_step")
     return packed_step_n_fn(word_axis, rule=rule)

@@ -1,0 +1,185 @@
+"""Batched data planes — many independent universes in one device tensor.
+
+Production traffic is millions of SMALL boards, not one huge one: the
+serving unit is a session (one universe, one turn budget), and the device
+unit is a batch tensor with a leading universe axis. These planes are the
+batch-shaped mirror of ops/plane.py — same duck-typed surface, plus the
+per-universe operations a session table needs (slot compaction, single-
+universe decode, one batched alive reduction):
+
+    encode(boards_uint8[B, H, W]) -> state      device batch state
+    step_n(state, n) -> state                   n turns for ALL universes,
+                                                one (or few) dispatches
+    decode(state) -> np.uint8 [B, H, W]         full host batch
+    decode_one(state, i) -> np.uint8 [H, W]     one universe (session exit)
+    alive_counts(state) -> np.int64 [B]         ONE batched reduction
+    take(state, rows) -> state                  slot compaction: keep rows,
+                                                in order (a device gather)
+
+Kernel family (ops/auto.auto_batch_plane picks the tier):
+
+* ``BatchBitPlane`` — int32 bitboards [B, H/32, W]: the batched pallas
+  VMEM kernel (explicit batch GRID dimension — the per-program working
+  set stays one universe, so the single-board VMEM gate applies per
+  universe) on real TPU, the vmapped XLA bitboard step elsewhere or past
+  the gate.
+* ``BatchBytePlane`` — uint8 [B, H, W] via the vmapped roll stencil:
+  every geometry, any life-like rule.
+
+Every tier is bit-identical per universe to its sequential single-board
+counterpart: the batch axis only amortises the per-launch dispatch
+latency that floors small boards (BENCH_r04: 128^2 latency-bound at
+~0.10 us/turn), it never changes the arithmetic.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..models import CONWAY, LifeRule
+from ..obs import device as _device
+
+# batch shape -> whether the batched VMEM kernel actually compiled+ran
+# (the ops/plane.py _VMEM_KERNEL_OK posture: first failure for a shape
+# routes it to the XLA batch path, cached so the compile never re-runs)
+_BATCH_VMEM_OK: dict = {}
+
+
+def _require_batch(boards) -> np.ndarray:
+    boards = np.asarray(boards, np.uint8)
+    if boards.ndim != 3:
+        raise ValueError(f"batch boards must be [B, H, W], got {boards.shape}")
+    return boards
+
+
+class _BatchPlane:
+    """The representation-agnostic batch operations: slot compaction and
+    join are pure leading-axis gathers/concats, identical for every
+    tier — one definition so a compaction-semantics fix cannot make the
+    tiers diverge."""
+
+    def take(self, state, rows: Sequence[int]):
+        import jax.numpy as jnp
+
+        return jnp.take(state, jnp.asarray(list(rows), jnp.int32), axis=0)
+
+    def append(self, state, other):
+        import jax.numpy as jnp
+
+        if state is None:
+            return other
+        return jnp.concatenate([state, other], axis=0)
+
+
+class BatchBytePlane(_BatchPlane):
+    """Batched identity representation: a device uint8 {0,255} [B, H, W]
+    tensor stepped by the vmapped roll stencil — handles every geometry
+    and rule (the roll-stencil tier of the batched family)."""
+
+    def __init__(self, rule: LifeRule = CONWAY):
+        self.rule = rule
+
+    def encode(self, boards):
+        import jax.numpy as jnp
+
+        return jnp.asarray(_require_batch(boards))
+
+    def step_n(self, state, n: int):
+        from .stencil import step_n_batch
+
+        return step_n_batch(
+            state,
+            int(n),
+            birth_mask=self.rule.birth_mask,
+            survive_mask=self.rule.survive_mask,
+        )
+
+    def decode(self, state) -> np.ndarray:
+        return np.asarray(state)
+
+    def decode_one(self, state, i: int) -> np.ndarray:
+        return np.asarray(state[i])
+
+    def alive_counts(self, state) -> np.ndarray:
+        from .reduce import alive_count_batch
+
+        return np.asarray(alive_count_batch(state)).astype(np.int64)
+
+
+class BatchBitPlane(_BatchPlane):
+    """Batched int32 bitboard representation: [B, H/32, W] (word_axis=0)
+    or [B, H, W/32]. ``step_n`` routes by tier: the batched pallas VMEM
+    kernel (one grid program per universe) on real TPU while a SINGLE
+    universe fits the VMEM working-set gate, else the vmapped XLA
+    bitboard step; ``alive_counts`` is one batched popcount reduction."""
+
+    def __init__(
+        self,
+        rule: LifeRule = CONWAY,
+        word_axis: int = 0,
+        interpret: Optional[bool] = None,
+    ):
+        from .pallas_stencil import default_interpret
+
+        self.rule = rule
+        self.word_axis = word_axis
+        self.interpret = default_interpret() if interpret is None else interpret
+
+    def encode(self, boards):
+        import jax.numpy as jnp
+
+        from .bitpack import pack_device_batch
+
+        return pack_device_batch(
+            jnp.asarray(_require_batch(boards)), self.word_axis
+        )
+
+    def step_n(self, state, n: int):
+        from . import pallas_stencil
+        from .bitpack import bit_step_n_batch
+        from .plane import run_vmem_gated
+
+        n = int(n)
+        birth, survive = self.rule.birth_mask, self.rule.survive_mask
+        shape = tuple(state.shape)
+
+        def fallback():
+            return _device.compile_and_call(
+                "bitpack.xla_step_batch", bit_step_n_batch,
+                state, n, self.word_axis, birth, survive,
+                static_argnums=(1, 2, 3, 4),
+            )
+
+        # the VMEM gate is PER UNIVERSE (the batch grid gives each program
+        # one board's working set); interpret-mode pallas would trace the
+        # grid serially — B copies of the loop — so off-TPU the vmapped
+        # XLA step is both the fast and the compile-sane tier
+        if not self.interpret and pallas_stencil.fits_vmem(
+            shape[1:], itemsize=4
+        ):
+            return run_vmem_gated(
+                _BATCH_VMEM_OK,
+                shape,
+                lambda: pallas_stencil._bit_compiled_batch(
+                    n, self.word_axis, self.interpret, birth, survive
+                )(state),
+                fallback,
+            )
+        return fallback()
+
+    def decode(self, state) -> np.ndarray:
+        from .bitpack import unpack_device_batch
+
+        return np.asarray(unpack_device_batch(state, self.word_axis))
+
+    def decode_one(self, state, i: int) -> np.ndarray:
+        from .bitpack import unpack_device
+
+        return np.asarray(unpack_device(state[i], self.word_axis))
+
+    def alive_counts(self, state) -> np.ndarray:
+        from .bitpack import alive_count_packed_batch
+
+        return alive_count_packed_batch(state)

@@ -126,6 +126,20 @@ def unpack_device(packed, word_axis: int = 0):
     return (board * 255).astype(jnp.uint8)
 
 
+@functools.partial(jax.jit, static_argnums=(1,))
+def pack_device_batch(boards, word_axis: int = 0):
+    """On-device batched ``pack``: uint8 {0,255} [B, H, W] -> int32
+    bitboards with a leading batch axis ([B, H/32, W] for word_axis=0).
+    One dispatch packs every universe of a session batch."""
+    return jax.vmap(lambda b: pack_device(b, word_axis))(boards)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def unpack_device_batch(packed, word_axis: int = 0):
+    """On-device batched ``unpack``: int32 [B, ...] -> uint8 [B, H, W]."""
+    return jax.vmap(lambda p: unpack_device(p, word_axis))(packed)
+
+
 @jax.jit
 def _row_popcounts(packed):
     # int32 row sums are safe (a row covers <= 32 * W cells); the final
@@ -306,6 +320,44 @@ def bit_step_n(
         ),
         packed,
     )
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
+def bit_step_n_batch(
+    packed,
+    n: int,
+    word_axis: int = 0,
+    birth_mask: int = CONWAY_BIRTH_MASK,
+    survive_mask: int = CONWAY_SURVIVE_MASK,
+):
+    """n turns over a batch of independent bitboards ``int32[B, ...]`` in
+    one dispatch: ``vmap`` of ``bit_step`` over the leading axis inside a
+    single ``lax.fori_loop``. The XLA tier of the batched kernel family —
+    every geometry the single-board bitboard step handles, amortising the
+    per-launch dispatch latency over all B universes."""
+    one = jax.vmap(
+        lambda b: bit_step(
+            b, word_axis, birth_mask=birth_mask, survive_mask=survive_mask
+        )
+    )
+    return lax.fori_loop(0, n, lambda _, bs: one(bs), packed)
+
+
+@jax.jit
+def _batch_word_popcounts(packed):
+    # per-universe popcounts reduced over the trailing (word) axes on
+    # device, int32-safe per partial row; the final per-universe total is
+    # accumulated on host in int64 (the alive_count_packed posture)
+    return jnp.sum(lax.population_count(packed), axis=-1)
+
+
+def alive_count_packed_batch(packed) -> np.ndarray:
+    """Per-universe alive counts of a batched bitboard ``int32[B, ...]``
+    as ``np.int64[B]`` — ONE batched device-side popcount reduction, a
+    [B, rows]-int32 transfer, and a host int64 fold. The demux source for
+    every per-session AliveCellsCount ticker in a session batch."""
+    pc = np.asarray(_batch_word_popcounts(packed))
+    return np.sum(pc.reshape(pc.shape[0], -1), axis=1, dtype=np.int64)
 
 
 @functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))

@@ -232,6 +232,74 @@ def _bit_compiled(
     return _device.instrument_jit("pallas.vmem_bit", run)
 
 
+def _bit_kernel_batch(
+    packed_ref, out_ref, *, n, word_axis, interpret, birth_mask, survive_mask
+):
+    # one grid program per universe: the (1, Hw, W) block squeezes to the
+    # single-board shape (a layout no-op), runs the SAME n-turn bit_step
+    # loop as _bit_kernel entirely in VMEM, and writes its board back —
+    # HBM touched twice per universe per launch, for the whole batch
+    from .bitpack import bit_step
+
+    rot1 = pick_rot1(interpret)
+
+    def step(b):
+        return bit_step(
+            b, word_axis, rot1, birth_mask=birth_mask, survive_mask=survive_mask
+        )
+
+    board = packed_ref[:].reshape(packed_ref.shape[1:])
+    out = lax.fori_loop(0, n // 2, lambda _, b: step(step(b)), board)
+    if n % 2:
+        out = step(out)
+    out_ref[:] = out.reshape(out_ref.shape)
+
+
+@functools.lru_cache(maxsize=None)
+def _bit_compiled_batch(
+    n: int,
+    word_axis: int,
+    interpret: bool,
+    birth_mask: int | None = None,
+    survive_mask: int | None = None,
+):
+    """The batched VMEM bitboard kernel: ``int32[B, Hw, W] -> [B, Hw, W]``,
+    n turns for B independent universes in ONE launch. Where ``vmap``
+    would hand XLA a batched op graph (bit-plane temporaries spilling to
+    HBM once the batch outgrows on-chip memory), an EXPLICIT batch grid
+    dimension keeps the per-program working set at one universe — the
+    single-board VMEM gate applies per universe, not per batch, so a
+    thousand 128^2 boards batch into one launch that amortises the
+    dispatch-latency floor (BENCH_r04) N ways."""
+    from jax.experimental import pallas as pl
+
+    from .stencil import CONWAY_BIRTH_MASK, CONWAY_SURVIVE_MASK
+
+    kernel = functools.partial(
+        _bit_kernel_batch,
+        n=n,
+        word_axis=word_axis,
+        interpret=interpret,
+        birth_mask=CONWAY_BIRTH_MASK if birth_mask is None else birth_mask,
+        survive_mask=CONWAY_SURVIVE_MASK if survive_mask is None else survive_mask,
+    )
+
+    @jax.jit
+    def run(packed):
+        b, rows, width = packed.shape
+        return pl.pallas_call(
+            kernel,
+            grid=(b,),
+            in_specs=[pl.BlockSpec((1, rows, width), lambda i: (i, 0, 0))],
+            out_specs=pl.BlockSpec((1, rows, width), lambda i: (i, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct(packed.shape, packed.dtype),
+            interpret=interpret,
+        )(packed)
+
+    # compile wall + cost analysis attributed to this kernel site (obs/)
+    return _device.instrument_jit("pallas.vmem_bit_batch", run)
+
+
 def pallas_bit_step_n_fn(
     *, word_axis: int = 0, interpret: bool | None = None, rule=None
 ):

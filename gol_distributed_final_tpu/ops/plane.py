@@ -34,6 +34,26 @@ from ..obs import device as _device
 _VMEM_KERNEL_OK: dict = {}
 
 
+def run_vmem_gated(cache: dict, key, kernel_call, fallback_call):
+    """The VMEM-gate execution posture, shared by the single-board
+    (``BitPlane``) and batched (``ops/batched.BatchBitPlane``) bitboard
+    planes so the policy cannot diverge: try the pallas VMEM kernel while
+    the cached gate admits ``key``; the FIRST failure for a key routes it
+    to ``fallback_call`` and is cached so the compile is never
+    re-attempted; a key that compiled before re-raises (a real runtime
+    error, not a mis-calibrated gate)."""
+    if cache.get(key, True):
+        try:
+            out = kernel_call()
+            cache[key] = True
+            return out
+        except Exception:
+            if cache.get(key):
+                raise
+            cache[key] = False
+    return fallback_call()
+
+
 class BytePlane:
     """The identity representation: a device uint8 {0,255} board.
 
@@ -106,28 +126,30 @@ class BitPlane:
         n = int(n)
         birth, survive = self.rule.birth_mask, self.rule.survive_mask
         shape = tuple(state.shape)
-        if pallas_stencil.fits_vmem(shape, itemsize=4) and _VMEM_KERNEL_OK.get(
-            shape, True
-        ):
-            try:
-                out = pallas_stencil._bit_compiled(
+
+        def fallback():
+            if not self.interpret and self.word_axis == 0 and can_tile(shape):
+                return tiled_bit_step_n_fn(rule=self.rule, interpret=False)(
+                    state, n
+                )
+            # compile wall + cost analysis attributed to the XLA bitboard
+            # fallback (obs/device.py); semantics identical to a direct call
+            return _device.compile_and_call(
+                "bitpack.xla_step", bit_step_n,
+                state, n, self.word_axis, birth, survive,
+                static_argnums=(1, 2, 3, 4),
+            )
+
+        if pallas_stencil.fits_vmem(shape, itemsize=4):
+            return run_vmem_gated(
+                _VMEM_KERNEL_OK,
+                shape,
+                lambda: pallas_stencil._bit_compiled(
                     n, self.word_axis, self.interpret, birth, survive
-                )(state)
-                _VMEM_KERNEL_OK[shape] = True
-                return out
-            except Exception:
-                if _VMEM_KERNEL_OK.get(shape):
-                    raise  # this shape compiled before: a real runtime error
-                _VMEM_KERNEL_OK[shape] = False  # mis-calibrated gate: fall back
-        if not self.interpret and self.word_axis == 0 and can_tile(shape):
-            return tiled_bit_step_n_fn(rule=self.rule, interpret=False)(state, n)
-        # compile wall + cost analysis attributed to the XLA bitboard
-        # fallback (obs/device.py); semantics identical to a direct call
-        return _device.compile_and_call(
-            "bitpack.xla_step", bit_step_n,
-            state, n, self.word_axis, birth, survive,
-            static_argnums=(1, 2, 3, 4),
-        )
+                )(state),
+                fallback,
+            )
+        return fallback()
 
     def decode(self, state) -> np.ndarray:
         from .bitpack import unpack_device

@@ -23,6 +23,15 @@ def alive_count(board: jax.Array) -> jax.Array:
     return jnp.sum(board != 0, dtype=jnp.int32)
 
 
+@jax.jit
+def alive_count_batch(boards: jax.Array) -> jax.Array:
+    """Per-universe alive counts of a batched byte board ``[B, H, W]`` as
+    a device ``int32[B]`` — ONE batched reduction for the whole session
+    batch, from which every per-session AliveCellsCount ticker demuxes
+    (B scalars cross the device boundary, never B boards)."""
+    return jnp.sum(boards != 0, axis=(1, 2), dtype=jnp.int32)
+
+
 def alive_cells(board) -> list[Cell]:
     """Coordinates of alive cells as ``Cell(x, y)``, row-major."""
     arr = np.asarray(board)

@@ -1390,6 +1390,135 @@ class WorkersBackend:
         return spans
 
 
+class SessionScheduler:
+    """Multi-universe serving: concurrent ``Operations.SessionRun`` verbs
+    packed into ONE device-resident batch (engine/sessions.SessionTable).
+
+    Each SessionRun keeps Run's blocking contract — the handler thread
+    parks on its session's completion — while a single driver thread
+    advances the whole batch: one dispatch per k-turn batch for every
+    universe, one batched reduction for every alive count, the host
+    touching the batch only at those boundaries. Admission control
+    (``-session-capacity``) refuses loudly instead of queueing
+    unboundedly; the batch serves one geometry/rule at a time (the
+    batching constraint — a mismatched admission is rejected, and the
+    first admission after the table drains may claim a new geometry).
+
+    A nonzero client-chosen ``Request.session_id`` tags the session so a
+    concurrent Retrieve with the same tag serves THAT universe's
+    per-session snapshot — the AliveCellsCount ticker contract, per
+    universe."""
+
+    def __init__(self, capacity: int = 256, max_chunk: int = 4096):
+        if capacity < 1:
+            raise ValueError(f"session capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self.max_chunk = max_chunk
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        self._table = None  # current SessionTable (one geometry/rule)
+        self._tags: dict[int, object] = {}  # session_id -> Session
+        self._thread: threading.Thread | None = None
+        self._stop = False
+
+    def _rule_for(self, req: Request):
+        from ..models import CONWAY, LifeRule
+
+        rulestring = getattr(req, "rulestring", "")
+        if not rulestring:
+            return CONWAY
+        return LifeRule.from_rulestring(rulestring)
+
+    def submit(self, req: Request) -> RunResult:
+        """Blocking: admit this Run into the batch, wait for its universe
+        to finish, return its result. Raises ``SessionRejected`` on
+        admission refusal (error reply to the client)."""
+        from ..engine.sessions import SessionTable, reject
+
+        rule = self._rule_for(req)
+        shape = (req.image_height, req.image_width)
+        world = np.asarray(req.world, np.uint8)
+        tag = getattr(req, "session_id", 0)
+        with self._work:
+            if self._stop:
+                raise RpcError("broker is shutting down")
+            if self._table is not None and self._table.occupancy == 0 and (
+                self._table.shape != shape
+                or self._table.rule.rulestring != rule.rulestring
+            ):
+                # drained: the next admission may claim a new geometry
+                self._table = None
+            if self._table is None:
+                self._table = SessionTable(
+                    rule, shape, self.capacity, max_chunk=self.max_chunk
+                )
+            if self._table.rule.rulestring != rule.rulestring:
+                raise reject(
+                    "rule",
+                    f"this batch serves {self._table.rule.rulestring}, "
+                    f"not {rule.rulestring} (one rule per batch)",
+                )
+            if tag and tag in self._tags:
+                raise reject("tag", f"session tag {tag} already in use")
+            # geometry/capacity/turns admission happens in the table
+            sess = self._table.admit(world, req.turns)
+            if tag:
+                self._tags[tag] = sess
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._drive, daemon=True
+                )
+                self._thread.start()
+            self._work.notify_all()
+        try:
+            sess.done.wait()
+        finally:
+            with self._lock:
+                if tag and self._tags.get(tag) is sess:
+                    del self._tags[tag]
+        if sess.error is not None:
+            raise RpcError(f"session batch failed: {sess.error}")
+        return RunResult(sess.turns_done, sess.result)
+
+    def retrieve(self, tag: int, include_world: bool) -> Snapshot:
+        """The per-session Retrieve surface: the (turn, alive) pair — and
+        optionally the board — of ONE universe, demuxed from the batch."""
+        with self._lock:
+            sess = self._tags.get(tag)
+            table = self._table
+        if sess is None or table is None:
+            raise RpcError(f"no session with tag {tag}")
+        world, turn, alive = table.snapshot(sess, include_world=include_world)
+        return Snapshot(world, turn, alive)
+
+    def _drive(self) -> None:
+        """The driver thread: advance the batch whenever it has work; on
+        an advance failure, fail every in-flight session loudly (their
+        blocked handlers re-raise) rather than hanging them."""
+        while True:
+            with self._work:
+                while not self._stop and (
+                    self._table is None or self._table.occupancy == 0
+                ):
+                    self._work.wait()
+                if self._stop:
+                    return
+                table = self._table
+            try:
+                table.advance()
+            except Exception as exc:  # noqa: BLE001 — must not hang waiters
+                logger.exception("session batch driver failed")
+                table.fail_all(exc)
+
+    def close(self) -> None:
+        with self._work:
+            self._stop = True
+            table, self._table = self._table, None
+            self._work.notify_all()
+        if table is not None:
+            table.fail_all(RpcError("broker is shutting down"))
+
+
 def _require_request(req) -> Request:
     """Version-skew tolerance is for REQUEST OBJECTS missing newer fields
     (read via getattr below), never for arbitrary deserialised frames: a
@@ -1410,11 +1539,28 @@ class BrokerService:
     machinery, then the stash is consumed — later detach/reattach Runs
     start fresh, preserving the reference's reset-on-Run semantics."""
 
-    def __init__(self, server: RpcServer, backend, resume=None):
+    def __init__(
+        self,
+        server: RpcServer,
+        backend,
+        resume=None,
+        session_capacity: int = 256,
+    ):
         self._server = server
         self.backend = backend
         self._resume = resume  # (world, turn, rule) | None
         self.quit_event = threading.Event()
+        # multi-universe serving (Operations.SessionRun): built lazily so
+        # a broker that never serves sessions never starts the driver
+        self._session_capacity = session_capacity
+        self._sessions: SessionScheduler | None = None
+        self._sessions_lock = threading.Lock()
+
+    def _session_scheduler(self) -> SessionScheduler:
+        with self._sessions_lock:
+            if self._sessions is None:
+                self._sessions = SessionScheduler(self._session_capacity)
+            return self._sessions
 
     def _apply_resume(self, req: Request) -> None:
         """Rewrite a fresh Run to continue from the -resume checkpoint.
@@ -1503,6 +1649,31 @@ class BrokerService:
             world=result.world,
         )
 
+    def session_run(self, req: Request) -> Response:
+        """Operations.SessionRun — Run's blocking contract, many at once:
+        concurrent handler threads admit into one device-batched session
+        table (admission control refuses past -session-capacity) and each
+        parks until ITS universe finishes. Available on every backend —
+        sessions always run on this process's own device, independent of
+        the single-board data plane the classic Run verb uses."""
+        req = _require_request(req)
+        if req.world is None or req.world.shape != (
+            req.image_height,
+            req.image_width,
+        ):
+            raise ValueError(
+                f"world shape "
+                f"{None if req.world is None else req.world.shape} does "
+                f"not match params {req.image_width}x{req.image_height}"
+            )
+        result = self._session_scheduler().submit(req)
+        return Response(
+            alive=[],
+            alive_count=int(np.count_nonzero(result.world)),
+            turns_completed=result.turns_completed,
+            world=result.world,
+        )
+
     def pause(self, req: Request) -> Response:
         self.backend.pause()
         return Response()
@@ -1553,11 +1724,25 @@ class BrokerService:
         return Response(status=payload)
 
     def retrieve(self, req: Request) -> Response:
+        req = _require_request(req)
+        # session_id is an extension field (getattr: absent on a version-
+        # skewed older client's pickle, meaning the broker-global board):
+        # a nonzero tag routes to THAT universe's per-session snapshot —
+        # the AliveCellsCount ticker contract, demuxed per universe
+        tag = getattr(req, "session_id", 0)
+        if tag:
+            snap = self._session_scheduler().retrieve(
+                tag, getattr(req, "include_world", True)
+            )
+            return Response(
+                alive_count=snap.alive_count,
+                turns_completed=snap.turns_completed,
+                world=snap.world,
+                alive=[],
+            )
         # include_world is an extension field too: absent means the
         # original full-world Retrieve
-        snap = self.backend.retrieve(
-            getattr(_require_request(req), "include_world", True)
-        )
+        snap = self.backend.retrieve(getattr(req, "include_world", True))
         # alive stays empty on the wire: the client derives cells from the
         # world locally, and pickling ~10^5 Cell objects per snapshot is
         # pure waste (the reference DOES ship them, broker/broker.go:272)
@@ -1569,6 +1754,10 @@ class BrokerService:
         )
 
     def _shutdown(self):
+        with self._sessions_lock:
+            sessions = self._sessions
+        if sessions is not None:
+            sessions.close()  # in-flight sessions fail loudly, never hang
         self._server.stop()
         self.quit_event.set()
 
@@ -1586,6 +1775,7 @@ def serve(
     probe_interval: float = 1.0,
     sync_interval: int = 256,
     ckpt_keep: int = 1,
+    session_capacity: int = 256,
 ) -> tuple[RpcServer, BrokerService]:
     server = RpcServer(host=host, port=port)
     impl = (
@@ -1602,8 +1792,11 @@ def serve(
         if backend == "workers"
         else TpuBackend(halo_depth=halo_depth)
     )
-    service = BrokerService(server, impl, resume=resume)
+    service = BrokerService(
+        server, impl, resume=resume, session_capacity=session_capacity
+    )
     server.register(Methods.BROKER_RUN, service.run)
+    server.register(Methods.SESSION_RUN, service.session_run)
     server.register(Methods.PAUSE, service.pause)
     server.register(Methods.QUIT, service.quit)
     server.register(Methods.SUPER_QUIT, service.super_quit)
@@ -1691,6 +1884,14 @@ def main(argv=None) -> None:
              "against silent corruption",
     )
     parser.add_argument(
+        "-session-capacity", dest="session_capacity", type=int, default=256,
+        metavar="N",
+        help="multi-universe serving: max concurrent SessionRun universes "
+             "packed into the device-resident session batch; admissions "
+             "past the bound are refused with an error reply "
+             "(gol_sessions_rejected_total{reason=capacity})",
+    )
+    parser.add_argument(
         "-probe-interval", dest="probe_interval", type=float, default=1.0,
         metavar="SECS",
         help="workers backend: base cadence of the background readmission "
@@ -1731,6 +1932,10 @@ def main(argv=None) -> None:
                      "search; it does nothing here")
     if args.halo_depth < 1:
         parser.error(f"-halo-depth must be >= 1, got {args.halo_depth}")
+    if args.session_capacity < 1:
+        parser.error(
+            f"-session-capacity must be >= 1, got {args.session_capacity}"
+        )
     if (
         args.halo_depth > 1
         and args.backend == "workers"
@@ -1809,6 +2014,7 @@ def main(argv=None) -> None:
         probe_interval=args.probe_interval,
         sync_interval=args.sync_interval,
         ckpt_keep=args.ckpt_keep,
+        session_capacity=args.session_capacity,
     )
     print(f"broker listening on :{server.port} (backend={args.backend})", flush=True)
     service.quit_event.wait()

@@ -441,6 +441,37 @@ class RemoteBroker:
         # compatibility with servers that do ship cells
         return RunResult(res.turns_completed, res.world, res.alive or None)
 
+    def session_run(
+        self,
+        params,
+        world,
+        *,
+        session_id: int = 0,
+        rule=None,
+        timeout: float | None = None,
+    ):
+        """Blocking multi-universe Run (Operations.SessionRun): this
+        universe joins the broker's device-resident session batch and the
+        call returns ITS final board. Many may be issued concurrently
+        (each on its own connection/thread); a nonzero ``session_id``
+        tags the session so ``retrieve(session_id=...)`` serves its
+        per-universe ticker snapshot mid-flight. Admission refusals
+        (capacity / geometry / rule / tag) surface as RpcError replies."""
+        req = Request(
+            world=world,
+            turns=params.turns,
+            image_height=params.image_height,
+            image_width=params.image_width,
+            threads=params.threads,
+            rulestring=rule.rulestring if rule is not None else "",
+            session_id=session_id,
+        )
+        kw = {"timeout": timeout} if timeout is not None else {}
+        res = self.client.call(Methods.SESSION_RUN, req, **kw)
+        from ..engine.engine import RunResult
+
+        return RunResult(res.turns_completed, res.world, res.alive or None)
+
     def pause(self):
         self.client.call(Methods.PAUSE, Request())
 
@@ -450,8 +481,14 @@ class RemoteBroker:
     def super_quit(self):
         self.client.call(Methods.SUPER_QUIT, Request())
 
-    def retrieve(self, include_world: bool = True):
-        res = self.client.call(Methods.RETRIEVE, Request(include_world=include_world))
+    def retrieve(self, include_world: bool = True, session_id: int = 0):
+        # a nonzero session_id demuxes ONE universe's snapshot from the
+        # broker's session batch (the tag a session_run registered);
+        # 0 keeps the classic broker-global Retrieve
+        res = self.client.call(
+            Methods.RETRIEVE,
+            Request(include_world=include_world, session_id=session_id),
+        )
         from ..engine.engine import Snapshot
 
         return Snapshot(res.world, res.turns_completed, res.alive_count)

@@ -52,6 +52,17 @@ class Methods:
     STRIP_START = "GameOfLifeOperations.StripStart"
     STRIP_STEP = "GameOfLifeOperations.StripStep"
     STRIP_FETCH = "GameOfLifeOperations.StripFetch"
+    # extension: multi-universe serving (rpc/broker.SessionScheduler).
+    # SessionRun has Run's blocking contract — evolve this world for
+    # req.turns and reply with the final board — but MANY may be in
+    # flight at once: concurrent sessions of one geometry/rule pack into
+    # a device-resident batch tensor, advanced together (one dispatch per
+    # k-turn batch amortises the per-launch dispatch-latency floor over
+    # every universe). Admission control (capacity / geometry / rule)
+    # refuses with an error reply instead of queueing unboundedly. A
+    # nonzero req.session_id tags the session so RetrieveCurrentData with
+    # the same tag serves THAT universe's (turn, alive count, board).
+    SESSION_RUN = "Operations.SessionRun"
 
 
 @dataclasses.dataclass
@@ -83,6 +94,14 @@ class Request:
     # pickle simply lacks it and skew degrades to "no trace", never an
     # AttributeError. None = the caller isn't tracing.
     trace_ctx: Optional[dict] = None
+    # extension: the multi-universe serving tag (Methods.SESSION_RUN).
+    # A CLIENT-CHOSEN nonzero id on SessionRun registers the session so a
+    # concurrent RetrieveCurrentData carrying the same id serves that
+    # universe's per-session snapshot (demuxed from the batched
+    # reduction) instead of the broker-global board. 0 (and a
+    # version-skewed pickle without the field, via getattr) = untagged /
+    # the classic broker-global Retrieve.
+    session_id: int = 0
 
 
 @dataclasses.dataclass

@@ -1,0 +1,579 @@
+"""Multi-universe serving suite: batched kernels, session table, scheduler.
+
+Covers the three layers the device-batched serving surface stands on:
+
+* the batched kernel family (``ops/stencil.step_n_batch``,
+  ``ops/bitpack.bit_step_n_batch``, ``ops/pallas_stencil._bit_compiled_batch``,
+  the batched reductions) — every tier against a per-universe numpy-oracle
+  loop over MIXED batches (all-dead and single-glider universes riding
+  beside dense random ones in one tensor);
+* ``engine/sessions.SessionTable`` — admission control (capacity /
+  geometry / turns refusals with metered reasons), mid-batch leave with
+  slot compaction, per-session event demux exactness from the one batched
+  reduction, per-session snapshots, join at a chunk boundary;
+* ``rpc/broker.SessionScheduler`` + the ``Operations.SessionRun`` verb —
+  concurrent blocking sessions over a live in-process broker, per-session
+  tagged Retrieve, capacity refusal as an error reply.
+
+Run standalone via ``scripts/check --sessions``.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gol_distributed_final_tpu.models import CONWAY, LifeRule
+from gol_distributed_final_tpu.obs import metrics as obs_metrics
+
+from oracle import vector_step
+
+HIGHLIFE = LifeRule.from_rulestring("B36/S23", name="highlife")
+
+
+def _seq(board, n, birth=(3,), survive=(2, 3)):
+    """Per-universe oracle loop: n turns of the independent numpy stencil."""
+    for _ in range(n):
+        board = vector_step(board, birth, survive)
+    return board
+
+
+def _mixed_batch(b=6, h=64, w=64, seed=0):
+    """A batch with mixed liveness: universe 0 all dead, universe 1 a lone
+    glider, the rest dense random — one tensor, very different dynamics."""
+    rng = np.random.default_rng(seed)
+    boards = np.where(rng.random((b, h, w)) < 0.3, 255, 0).astype(np.uint8)
+    if b > 1:
+        boards[0] = 0
+        boards[1] = 0
+        for y, x in ((1, 2), (2, 3), (3, 1), (3, 2), (3, 3)):
+            boards[1, y, x] = 255
+    return boards
+
+
+def _oracle_batch(boards, n, birth=(3,), survive=(2, 3)):
+    return np.stack([_seq(b, n, birth, survive) for b in boards])
+
+
+@pytest.fixture
+def live_metrics():
+    reg = obs_metrics.registry()
+    reg.reset()
+    obs_metrics.enable()
+    yield reg
+    obs_metrics.enable(False)
+    reg.reset()
+
+
+def _metric(name, labels=()):
+    for fam in obs_metrics.registry().snapshot()["families"]:
+        if fam["name"] == name:
+            for s in fam["series"]:
+                if tuple(s.get("labels", ())) == tuple(labels):
+                    return s["value"]
+    return 0.0
+
+
+# -- batched kernel family ---------------------------------------------------
+
+
+def test_batched_byte_tier_parity_mixed_batch():
+    from gol_distributed_final_tpu.ops.stencil import step_n_batch
+
+    boards = _mixed_batch()
+    want = _oracle_batch(boards, 8)
+    got = np.asarray(step_n_batch(boards, 8))
+    assert np.array_equal(got, want)
+    # non-Conway rule through the same batched tier
+    want_hl = _oracle_batch(boards, 5, birth=(3, 6), survive=(2, 3))
+    got_hl = np.asarray(
+        step_n_batch(
+            boards, 5,
+            birth_mask=HIGHLIFE.birth_mask,
+            survive_mask=HIGHLIFE.survive_mask,
+        )
+    )
+    assert np.array_equal(got_hl, want_hl)
+
+
+def test_batched_xla_bit_tier_parity_mixed_batch():
+    from gol_distributed_final_tpu.ops import bitpack
+
+    boards = _mixed_batch()
+    want = _oracle_batch(boards, 8)
+    packed = bitpack.pack_device_batch(boards)
+    out = bitpack.bit_step_n_batch(packed, 8)
+    assert np.array_equal(
+        np.asarray(bitpack.unpack_device_batch(out)), want
+    )
+    # word_axis=1 packing too
+    packed1 = bitpack.pack_device_batch(boards, 1)
+    out1 = bitpack.bit_step_n_batch(packed1, 8, 1)
+    assert np.array_equal(
+        np.asarray(bitpack.unpack_device_batch(out1, 1)), want
+    )
+
+
+def test_batched_pallas_tier_parity_mixed_batch():
+    from gol_distributed_final_tpu.ops import bitpack
+    from gol_distributed_final_tpu.ops.pallas_stencil import _bit_compiled_batch
+
+    boards = _mixed_batch(b=3, h=32, w=32, seed=2)
+    want = _oracle_batch(boards, 6)
+    packed = bitpack.pack_device_batch(boards)
+    out = _bit_compiled_batch(6, 0, True)(packed)  # interpret: CPU mesh
+    assert np.array_equal(np.asarray(bitpack.unpack_device_batch(out)), want)
+    # odd turn count exercises the unroll remainder
+    out5 = _bit_compiled_batch(5, 0, True)(packed)
+    assert np.array_equal(
+        np.asarray(bitpack.unpack_device_batch(out5)),
+        _oracle_batch(boards, 5),
+    )
+
+
+def test_batched_reductions_demux_per_universe():
+    from gol_distributed_final_tpu.ops import bitpack
+    from gol_distributed_final_tpu.ops.reduce import alive_count_batch
+
+    boards = _mixed_batch()
+    want = (boards != 0).sum(axis=(1, 2))
+    assert np.array_equal(np.asarray(alive_count_batch(boards)), want)
+    counts = bitpack.alive_count_packed_batch(bitpack.pack_device_batch(boards))
+    assert counts.dtype == np.int64
+    assert np.array_equal(counts, want)
+    assert counts[0] == 0  # the all-dead universe demuxes to exactly zero
+
+
+def test_batch_planes_decode_take_compaction():
+    from gol_distributed_final_tpu.ops.batched import (
+        BatchBitPlane,
+        BatchBytePlane,
+    )
+
+    boards = _mixed_batch()
+    want = _oracle_batch(boards, 4)
+    for plane in (BatchBitPlane(CONWAY), BatchBytePlane(CONWAY)):
+        state = plane.step_n(plane.encode(boards), 4)
+        assert np.array_equal(plane.decode(state), want)
+        assert np.array_equal(plane.decode_one(state, 1), want[1])
+        assert np.array_equal(
+            plane.alive_counts(state), (want != 0).sum(axis=(1, 2))
+        )
+        # slot compaction: keep rows [0, 2, 5] in order, batch stays dense
+        kept = plane.take(state, [0, 2, 5])
+        assert np.array_equal(plane.decode(kept), want[[0, 2, 5]])
+        # join: append a fresh universe to the compacted batch
+        joined = plane.append(kept, plane.encode(boards[3:4]))
+        assert np.array_equal(
+            plane.decode(joined), np.concatenate([want[[0, 2, 5]], boards[3:4]])
+        )
+
+
+def test_auto_batch_plane_selector_and_indivisible_geometry():
+    from gol_distributed_final_tpu.ops.auto import auto_batch_plane
+    from gol_distributed_final_tpu.ops.batched import (
+        BatchBitPlane,
+        BatchBytePlane,
+    )
+
+    assert isinstance(auto_batch_plane(CONWAY, (64, 64)), BatchBitPlane)
+    assert isinstance(auto_batch_plane(CONWAY, (64, 50)), BatchBitPlane)
+    plane = auto_batch_plane(CONWAY, (30, 30))
+    assert isinstance(plane, BatchBytePlane)  # no packable axis
+    # decisions are cached: the same key returns the same plane object
+    assert auto_batch_plane(CONWAY, (30, 30)) is plane
+    # the byte tier really serves the indivisible geometry
+    boards = _mixed_batch(b=4, h=30, w=30, seed=3)
+    state = plane.step_n(plane.encode(boards), 7)
+    assert np.array_equal(plane.decode(state), _oracle_batch(boards, 7))
+
+
+def test_auto_plane_selection_hoisted_once_per_decision(live_metrics):
+    """The ISSUE 7 small fix: auto_plane used to sample HBM and bump the
+    tier counter on EVERY call; per-session admission in a hot serving
+    loop must pay a dict hit instead — the counter moves once per NEW
+    (rule, shape) decision, never per universe."""
+    from gol_distributed_final_tpu.ops.auto import auto_batch_plane, auto_plane
+
+    shape = (96, 544)  # unique: never used elsewhere, so the cache is cold
+    before = _metric("gol_ops_plane_selected_total", ("bitplane",))
+    p1 = auto_plane(CONWAY, shape)
+    for _ in range(50):  # a hot admission loop
+        assert auto_plane(CONWAY, shape) is p1
+    after = _metric("gol_ops_plane_selected_total", ("bitplane",))
+    assert after - before == 1
+    bshape = (96, 576)
+    before = _metric("gol_ops_plane_selected_total", ("batch_bitplane",))
+    b1 = auto_batch_plane(CONWAY, bshape)
+    for _ in range(50):
+        assert auto_batch_plane(CONWAY, bshape) is b1
+    after = _metric("gol_ops_plane_selected_total", ("batch_bitplane",))
+    assert after - before == 1
+
+
+# -- session table lifecycle -------------------------------------------------
+
+
+def test_admission_rejects_at_capacity_geometry_turns(live_metrics):
+    from gol_distributed_final_tpu.engine.sessions import (
+        SessionRejected,
+        SessionTable,
+    )
+
+    table = SessionTable(CONWAY, (32, 32), capacity=2)
+    boards = _mixed_batch(b=3, h=32, w=32, seed=4)
+    table.admit(boards[0], 5)
+    table.admit(boards[1], 5)
+    with pytest.raises(SessionRejected) as exc:
+        table.admit(boards[2], 5)
+    assert exc.value.reason == "capacity"
+    with pytest.raises(SessionRejected) as exc:
+        table.admit(np.zeros((16, 16), np.uint8), 5)
+    assert exc.value.reason == "geometry"
+    with pytest.raises(SessionRejected) as exc:
+        table.admit(boards[0][:32, :32], 0)
+    assert exc.value.reason == "turns"
+    assert _metric("gol_sessions_rejected_total", ("capacity",)) == 1
+    assert _metric("gol_sessions_rejected_total", ("geometry",)) == 1
+    assert _metric("gol_sessions_rejected_total", ("turns",)) == 1
+    assert _metric("gol_sessions_admitted_total") == 2
+    assert _metric("gol_sessions_active") == 2
+
+
+def test_mid_batch_leave_frees_slot_without_stalling(live_metrics):
+    """Differing budgets: the 4-turn universe finishes first, its slot
+    compacts away (the device batch shrinks), and the survivors keep
+    advancing — bit-identical to their sequential runs throughout."""
+    from gol_distributed_final_tpu.engine.sessions import SessionTable
+
+    boards = _mixed_batch(b=3, h=32, w=32, seed=5)
+    table = SessionTable(CONWAY, (32, 32), capacity=4)
+    s_a = table.admit(boards[0], 5)
+    s_b = table.admit(boards[1], 4)
+    s_c = table.admit(boards[2], 9)
+    remaining = table.advance()  # k = 4: the smallest budget finishes
+    assert s_b.done.is_set() and not s_a.done.is_set() and not s_c.done.is_set()
+    assert remaining == 2
+    assert len(table._active) == 2 and table._state.shape[0] == 2
+    assert np.array_equal(s_b.result, _seq(boards[1], 4))
+    n = 0
+    while table.advance():
+        n += 1
+        assert n < 10
+    assert s_a.turns_done == 5 and s_b.turns_done == 4 and s_c.turns_done == 9
+    assert np.array_equal(s_a.result, _seq(boards[0], 5))
+    assert np.array_equal(s_c.result, _seq(boards[2], 9))
+    assert _metric("gol_sessions_active") == 0
+    # universe-turns: 3 sessions x 4 turns, then 2 x 1, then 1 x 4
+    assert _metric("gol_session_turns_total") == 3 * 4 + 2 * 1 + 1 * 4
+
+
+def test_cancel_is_a_mid_batch_leave():
+    from gol_distributed_final_tpu.engine.sessions import SessionTable
+
+    boards = _mixed_batch(b=2, h=32, w=32, seed=6)
+    table = SessionTable(CONWAY, (32, 32), capacity=2, max_chunk=2)
+    s_a = table.admit(boards[0], 8)
+    s_b = table.admit(boards[1], 8)
+    table.advance()  # both at turn 2
+    table.cancel(s_b)
+    while table.advance():
+        pass
+    assert s_b.done.is_set() and s_b.result is None
+    assert s_a.done.is_set() and s_a.turns_done == 8
+    assert np.array_equal(s_a.result, _seq(boards[0], 8))
+
+
+def test_per_session_event_demux_exactness():
+    """Every event a session observes demuxes from the ONE batched
+    reduction — turns and counts must match the per-universe oracle
+    exactly at every chunk boundary, and FinalTurnComplete's cell list
+    must be the final board's."""
+    from gol_distributed_final_tpu.engine.sessions import SessionTable
+    from gol_distributed_final_tpu.events import (
+        AliveCellsCount,
+        FinalTurnComplete,
+        TurnComplete,
+    )
+
+    boards = _mixed_batch(b=3, h=32, w=32, seed=7)
+    events = {0: [], 1: [], 2: []}
+    table = SessionTable(CONWAY, (32, 32), capacity=3)
+    sessions = [
+        table.admit(boards[i], budget, on_event=events[i].append)
+        for i, budget in enumerate((5, 3, 9))
+    ]
+    while table.advance():
+        pass
+    # chunk boundaries with power-of-two quantisation for heterogeneous
+    # budgets: k=2 (all, min 3 -> pow2 2), k=1 (min is 1), k=2, k=4
+    for i, budget in enumerate((5, 3, 9)):
+        ticks = [e for e in events[i] if isinstance(e, AliveCellsCount)]
+        turns = [e for e in events[i] if isinstance(e, TurnComplete)]
+        finals = [e for e in events[i] if isinstance(e, FinalTurnComplete)]
+        expected_turns = [t for t in (2, 3, 5, 9) if t <= budget]
+        assert [e.completed_turns for e in ticks] == expected_turns
+        assert [e.completed_turns for e in turns] == expected_turns
+        for e in ticks:  # count exactness vs the oracle at that turn
+            want = int(
+                np.count_nonzero(_seq(boards[i], e.completed_turns))
+            )
+            assert e.cells_count == want, (i, e.completed_turns)
+        assert len(finals) == 1
+        assert finals[0].completed_turns == budget
+        final_board = _seq(boards[i], budget)
+        got_cells = {(c.x, c.y) for c in finals[0].alive}
+        ys, xs = np.nonzero(final_board)
+        assert got_cells == {(int(x), int(y)) for x, y in zip(xs, ys)}
+        assert sessions[i].alive_count == int(np.count_nonzero(final_board))
+
+
+def test_session_snapshot_consistent_mid_drain():
+    from gol_distributed_final_tpu.engine.sessions import SessionTable
+
+    boards = _mixed_batch(b=2, h=32, w=32, seed=8)
+    table = SessionTable(CONWAY, (32, 32), capacity=2)
+    s_a = table.admit(boards[0], 4)
+    s_b = table.admit(boards[1], 8)
+    # pending snapshot serves the seed board at turn 0
+    world, turn, alive = table.snapshot(s_b, include_world=True)
+    assert turn == 0 and np.array_equal(world, boards[1])
+    assert alive == int(np.count_nonzero(boards[1]))
+    table.advance()  # k = 4: s_a retires, s_b at turn 4
+    world, turn, alive = table.snapshot(s_b, include_world=True)
+    want = _seq(boards[1], 4)
+    assert turn == 4 and np.array_equal(world, want)
+    assert alive == int(np.count_nonzero(want))
+    # finished session snapshot serves its result
+    world, turn, alive = table.snapshot(s_a, include_world=True)
+    assert turn == 4 and np.array_equal(world, _seq(boards[0], 4))
+
+
+def test_join_at_chunk_boundary_mid_flight():
+    """A universe admitted while the batch is mid-flight joins at the next
+    advance boundary and both finish bit-identical to sequential runs."""
+    from gol_distributed_final_tpu.engine.sessions import SessionTable
+
+    boards = _mixed_batch(b=2, h=32, w=32, seed=9)
+    table = SessionTable(CONWAY, (32, 32), capacity=2, max_chunk=2)
+    s_a = table.admit(boards[0], 6)
+    table.advance()  # a alone at turn 2
+    s_b = table.admit(boards[1], 4)
+    while table.advance():
+        pass
+    assert np.array_equal(s_a.result, _seq(boards[0], 6))
+    assert np.array_equal(s_b.result, _seq(boards[1], 4))
+    assert s_a.turns_done == 6 and s_b.turns_done == 4
+
+
+# -- the broker scheduler + RPC surface --------------------------------------
+
+
+def test_session_run_rpc_concurrent_parity():
+    """Concurrent SessionRun verbs over a live in-process broker: every
+    universe's reply is bit-identical to its sequential oracle run."""
+    from gol_distributed_final_tpu.params import Params
+    from gol_distributed_final_tpu.rpc import broker as rpc_broker
+    from gol_distributed_final_tpu.rpc.client import RemoteBroker
+
+    server, service = rpc_broker.serve(port=0, session_capacity=8)
+    try:
+        addr = f"127.0.0.1:{server.port}"
+        boards = _mixed_batch(b=5, h=32, w=32, seed=10)
+        budgets = [4, 7, 3, 9, 5]
+        results: dict = {}
+
+        def one(i):
+            rb = RemoteBroker(addr)
+            try:
+                results[i] = rb.session_run(
+                    Params(turns=budgets[i], image_width=32, image_height=32),
+                    boards[i],
+                )
+            finally:
+                rb.client.close()
+
+        threads = [
+            threading.Thread(target=one, args=(i,)) for i in range(5)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        for i in range(5):
+            assert results[i].turns_completed == budgets[i]
+            assert np.array_equal(
+                results[i].world, _seq(boards[i], budgets[i])
+            ), i
+    finally:
+        server.stop()
+
+
+def test_session_run_rpc_rejects_at_capacity(live_metrics):
+    """Admission past -session-capacity is an ERROR REPLY, not a queue:
+    pre-fill the broker's table to its bound, then a SessionRun refusal
+    names capacity and bumps the refusal counter."""
+    from gol_distributed_final_tpu.params import Params
+    from gol_distributed_final_tpu.rpc import broker as rpc_broker
+    from gol_distributed_final_tpu.rpc.client import RemoteBroker, RpcError
+
+    server, service = rpc_broker.serve(port=0, session_capacity=2)
+    try:
+        addr = f"127.0.0.1:{server.port}"
+        boards = _mixed_batch(b=3, h=32, w=32, seed=11)
+        # fill the table directly (deterministic: no driver race) — the
+        # scheduler's submit then sees a full table
+        from gol_distributed_final_tpu.engine.sessions import SessionTable
+
+        sched = service._session_scheduler()
+        with sched._work:
+            sched._table = SessionTable(CONWAY, (32, 32), 2)
+            sched._table.admit(boards[0], 50)
+            sched._table.admit(boards[1], 50)
+        rb = RemoteBroker(addr)
+        try:
+            with pytest.raises(RpcError, match="capacity|full"):
+                rb.session_run(
+                    Params(turns=5, image_width=32, image_height=32),
+                    boards[2],
+                )
+        finally:
+            rb.client.close()
+        assert _metric("gol_sessions_rejected_total", ("capacity",)) == 1
+    finally:
+        server.stop()
+
+
+def test_session_run_rpc_rejects_geometry_and_rule_mismatch():
+    from gol_distributed_final_tpu.params import Params
+    from gol_distributed_final_tpu.rpc import broker as rpc_broker
+    from gol_distributed_final_tpu.rpc.client import RemoteBroker, RpcError
+    from gol_distributed_final_tpu.engine.sessions import SessionTable
+
+    server, service = rpc_broker.serve(port=0, session_capacity=4)
+    try:
+        addr = f"127.0.0.1:{server.port}"
+        sched = service._session_scheduler()
+        boards = _mixed_batch(b=1, h=32, w=32, seed=12)
+        with sched._work:
+            sched._table = SessionTable(CONWAY, (32, 32), 4)
+            sched._table.admit(boards[0], 50)  # occupied: geometry is pinned
+        rb = RemoteBroker(addr)
+        try:
+            with pytest.raises(RpcError, match="geometry|batch"):
+                rb.session_run(
+                    Params(turns=5, image_width=16, image_height=16),
+                    np.zeros((16, 16), np.uint8),
+                )
+            with pytest.raises(RpcError, match="rule"):
+                rb.session_run(
+                    Params(turns=5, image_width=32, image_height=32),
+                    boards[0],
+                    rule=HIGHLIFE,
+                )
+        finally:
+            rb.client.close()
+    finally:
+        server.stop()
+
+
+def test_session_retrieve_by_tag_mid_flight():
+    """A nonzero session_id tags the session; Retrieve with the same tag
+    serves THAT universe's (turn, alive, board) demuxed from the batch —
+    consistent with the oracle at whatever turn the snapshot lands on."""
+    from gol_distributed_final_tpu.params import Params
+    from gol_distributed_final_tpu.rpc import broker as rpc_broker
+    from gol_distributed_final_tpu.rpc.broker import SessionScheduler
+    from gol_distributed_final_tpu.rpc.client import RemoteBroker, RpcError
+
+    server, service = rpc_broker.serve(port=0, session_capacity=4)
+    try:
+        addr = f"127.0.0.1:{server.port}"
+        # max_chunk=1: one turn per driver boundary, a wide mid-flight
+        # window for the tagged Retrieve to land in
+        with service._sessions_lock:
+            service._sessions = SessionScheduler(capacity=4, max_chunk=1)
+        boards = _mixed_batch(b=1, h=32, w=32, seed=13)
+        turns = 60
+        done = threading.Event()
+        result: dict = {}
+
+        def run():
+            rb = RemoteBroker(addr)
+            try:
+                result["r"] = rb.session_run(
+                    Params(turns=turns, image_width=32, image_height=32),
+                    boards[0],
+                    session_id=7,
+                )
+            finally:
+                rb.client.close()
+                done.set()
+
+        t = threading.Thread(target=run)
+        t.start()
+        rb2 = RemoteBroker(addr)
+        snap = None
+        try:
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline and not done.is_set():
+                try:
+                    snap = rb2.retrieve(include_world=True, session_id=7)
+                    break
+                except RpcError:
+                    time.sleep(0.01)  # not yet admitted
+            assert snap is not None, "never caught the session in flight"
+            want = _seq(boards[0], snap.turns_completed)
+            assert np.array_equal(snap.world, want)
+            assert snap.alive_count == int(np.count_nonzero(want))
+            # unknown tag is a loud error, not a silent global snapshot
+            with pytest.raises(RpcError, match="no session"):
+                rb2.retrieve(session_id=999)
+        finally:
+            rb2.client.close()
+        t.join(60)
+        assert np.array_equal(result["r"].world, _seq(boards[0], turns))
+    finally:
+        server.stop()
+
+
+# -- observability surface ---------------------------------------------------
+
+
+def test_watch_sessions_panel(live_metrics):
+    from gol_distributed_final_tpu.obs import instruments as ins
+    from gol_distributed_final_tpu.obs.watch import render_status
+
+    ins.SESSIONS_ACTIVE.set(12)
+    ins.SESSIONS_ADMITTED_TOTAL.inc(40)
+    ins.SESSIONS_REJECTED_TOTAL.labels("capacity").inc(3)
+    ins.SESSION_TURNS_TOTAL.inc(12345)
+    payload = {
+        "role": "broker",
+        "pid": 1,
+        "metrics_enabled": True,
+        "metrics": obs_metrics.registry().snapshot(),
+    }
+    frame = render_status("broker :8040", payload, None)
+    assert "SESSIONS" in frame
+    assert "active 12" in frame and "admitted 40" in frame
+    assert "capacity 3" in frame
+    assert "12,345" in frame
+    # an idle broker renders no SESSIONS panel
+    obs_metrics.registry().reset()
+    payload["metrics"] = obs_metrics.registry().snapshot()
+    assert "SESSIONS" not in render_status("broker :8040", payload, None)
+
+
+def test_lint_session_metrics_sections(tmp_path, repo_root):
+    from gol_distributed_final_tpu.obs import lint
+
+    assert lint.undocumented_session_metrics() == []
+    assert "Sessions" not in lint.missing_readme_sections()
+    bare = tmp_path / "README.md"
+    bare.write_text("# nothing\n")
+    missing = lint.undocumented_session_metrics(bare)
+    assert "gol_sessions_active" in missing
+    assert "gol_session_turns_total" in missing
+    assert "Sessions" in lint.missing_readme_sections(bare)
