@@ -214,6 +214,7 @@ def _bench_wire_modes(extra: dict) -> int:
     import numpy as np
 
     from gol_distributed_final_tpu.obs import metrics as obs_metrics
+    from gol_distributed_final_tpu.obs import timeline as obs_timeline
     from gol_distributed_final_tpu.rpc import integrity as _integrity
     from gol_distributed_final_tpu.rpc import worker as rpc_worker
     from gol_distributed_final_tpu.rpc.broker import WorkersBackend
@@ -232,20 +233,26 @@ def _bench_wire_modes(extra: dict) -> int:
     board = np.where(rng.random((size, size)) < 0.3, 255, 0).astype(np.uint8)
     want100 = None  # cross-mode parity reference (100 turns)
     try:
-        for wire, k, key, n_lo, n_hi, check in (
-            ("full", 1, "c7_wire_full", 30, 230, True),
-            ("haloed", 1, "c7_wire_haloed", 30, 230, True),
+        for wire, k, key, n_lo, n_hi, check, timeline in (
+            ("full", 1, "c7_wire_full", 30, 230, True, False),
+            ("haloed", 1, "c7_wire_haloed", 30, 230, True, False),
             # resident turns are much cheaper per RPC: wider endpoints so
             # the marginal work still dominates loopback timing noise
-            ("resident", 1, "c7_wire_resident_k1", 100, 1100, True),
-            ("resident", 8, "c7_wire_resident_k8", 100, 1100, True),
+            ("resident", 1, "c7_wire_resident_k1", 100, 1100, True, False),
+            ("resident", 8, "c7_wire_resident_k8", 100, 1100, True, False),
             # the same case UNDEFENDED (-integrity off, both sides): the
             # checked case above pays the in-header frame crcs + adler32
             # attestations, so the pair prices the integrity layer — the
             # overhead gate below holds it under 3% of resident turn cost
-            ("resident", 8, "c7_wire_resident_k8_nock", 100, 1100, False),
+            ("resident", 8, "c7_wire_resident_k8_nock", 100, 1100, False, False),
+            # the same case with the -timeline sampler ON (1 s cadence,
+            # the serving default): prices the always-on history + SLO
+            # evaluation; the overhead gate below holds it under 2%
+            ("resident", 8, "c7_wire_resident_k8_timeline", 100, 1100, True, True),
         ):
             _integrity.set_enabled(check)
+            if timeline:
+                obs_timeline.enable(period=1.0)
             backend = WorkersBackend(addrs, wire=wire, halo_depth=k)
             try:
                 def evolve(n, backend=backend):
@@ -276,6 +283,8 @@ def _bench_wire_modes(extra: dict) -> int:
                 )
             finally:
                 backend.close()
+                if timeline:
+                    obs_timeline.disable()
         print("parity wire modes ok (100 turns, cross-mode)", file=sys.stderr)
         hal = extra["c7_wire_haloed"]["wire_bytes_per_turn"]
         res8 = extra["c7_wire_resident_k8"]["wire_bytes_per_turn"]
@@ -323,8 +332,35 @@ def _bench_wire_modes(extra: dict) -> int:
             f"{2 * noise_us:.2f} us)",
             file=sys.stderr,
         )
+        # timeline overhead gate: sampler-on vs sampler-off resident K=8,
+        # the same noise-band posture as the integrity pair — always-on
+        # history must stay under 2% of resident turn cost or the
+        # "-timeline in production" story dies here, not in a deployment
+        tl = extra["c7_wire_resident_k8_timeline"]
+        pt_tl = tl["per_turn_us"]
+        tl_noise_us = sum(
+            c["spread_s"] / (c["n_hi"] - c["n_lo"]) * 1e6 for c in (ck, tl)
+        )
+        tl_overhead_pct = (pt_tl - pt_ck) / pt_ck * 100.0
+        tl["timeline_overhead_pct"] = round(tl_overhead_pct, 2)
+        if pt_tl - pt_ck > 0.02 * pt_ck + 2 * tl_noise_us:
+            print(
+                f"TIMELINE OVERHEAD GATE FAILURE: sampler-on resident k8 "
+                f"{pt_tl:.2f} us/turn vs off {pt_ck:.2f} "
+                f"({tl_overhead_pct:+.1f}%) exceeds 2% beyond the "
+                f"{tl_noise_us:.2f} us noise band",
+                file=sys.stderr,
+            )
+            return 1
+        print(
+            f"timeline overhead ok: sampler on {pt_tl:.2f} us/turn vs "
+            f"off {pt_ck:.2f} ({tl_overhead_pct:+.1f}%, band "
+            f"{2 * tl_noise_us:.2f} us)",
+            file=sys.stderr,
+        )
     finally:
         _integrity.set_enabled(True)
+        obs_timeline.disable()
         for server, _service in servers:
             server.stop()
     return 0

@@ -123,6 +123,15 @@ def main(argv=None) -> int:
         help="write out/report_<W>x<H>x<Turns>.json (metrics + device "
              "inventory) at FinalTurnComplete; implies -metrics",
     )
+    parser.add_argument(
+        "-timeline", nargs="?", const=1.0, default=None, type=float,
+        metavar="SECS",
+        help="enable the in-process metric timeline + SLO rulebook "
+             "(obs/timeline.py, obs/slo.py) at this sampling cadence "
+             "(default 1 s): server-side rates/p99s and alert states land "
+             "in the run report, and counter tracks join the -trace "
+             "Chrome export; implies -metrics",
+    )
     args = parser.parse_args(argv)
     if args.metrics or args.report:
         # before any instrumented path runs, so the report sees the whole
@@ -130,6 +139,12 @@ def main(argv=None) -> int:
         from .obs import metrics
 
         metrics.enable()
+    if args.timeline is not None:
+        if args.timeline <= 0:
+            parser.error(f"-timeline SECS must be > 0, got {args.timeline}")
+        from .obs import timeline
+
+        timeline.enable(period=args.timeline)  # implies metrics.enable()
     if args.trace:
         # likewise before any span site runs; the controller role labels
         # this process's track in the exported Chrome trace

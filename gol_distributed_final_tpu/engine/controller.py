@@ -482,8 +482,16 @@ def run(
                 if callable(status_fn):
                     payload = status_fn()
                     spans.extend(payload.get("trace_spans") or [])
+                # -timeline: counter tracks (throughput, HBM, queue
+                # depth) land on the same Perfetto timeline as the spans
+                from ..obs import timeline as _timeline
+
+                sampler = _timeline.sampler()
+                counters = (
+                    sampler.chrome_counter_samples() if sampler else ()
+                )
                 path = _tracing.write_chrome_trace(
-                    _tracing.trace_path(params, out_dir), spans
+                    _tracing.trace_path(params, out_dir), spans, counters
                 )
                 print(f"chrome trace written to {path}")
             except Exception as exc:

@@ -42,6 +42,7 @@ from ..obs import device as _device
 from ..obs import flight as _flight
 from ..obs import instruments as _ins
 from ..obs import metrics as _metrics
+from ..obs import timeline as _timeline
 from ..obs import tracing as _tracing
 from ..ops import alive_cells
 from ..utils.cell import Cell
@@ -408,6 +409,11 @@ class Engine:
                     # the watch dashboard; one cached early-return on
                     # backends without memory stats (CPU)
                     _device.sample_hbm()
+                # opportunistic timeline tick at the chunk boundary: a
+                # dispatch loop that saturates the GIL must still sample
+                # on cadence (one global load + branch while -timeline
+                # is off)
+                _timeline.maybe_sample()
                 if growing:
                     if multihost:
                         # the wall-clock cap is rank-local: unagreed it

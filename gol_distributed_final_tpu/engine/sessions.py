@@ -45,6 +45,7 @@ one lock, so a snapshot never pairs a new turn with a stale count.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, List, Optional
 
 import numpy as np
@@ -225,10 +226,22 @@ class SessionTable:
             # <= log2(T) + 2 dispatches, and sessions still land on
             # their budgets exactly.
             k = 1 << (k.bit_length() - 1)
+        t_chunk = time.monotonic()
         if k > 0:
             state = self._plane.step_n(state, k)
         # ONE batched reduction; every per-session count demuxes from it
         counts = self._plane.alive_counts(state)
+        if k > 0:
+            # the serving-latency objective (obs/slo.py session-turn-
+            # latency rule): this chunk's wall — the reduction forces the
+            # dispatch, so it is real time, not enqueue time — normalized
+            # per universe-turn; count == universe-turns, matching
+            # gol_session_turns_total, so rates agree across the two
+            m = sum(1 for s in active if not s.cancelled)
+            if m:
+                _ins.SESSION_TURN_SECONDS.observe_n(
+                    (time.monotonic() - t_chunk) / (k * m), k * m
+                )
 
         events: List[tuple[Session, object]] = []
         finished: List[int] = []

@@ -1,0 +1,576 @@
+"""Cluster triage doctor — one shot from symptoms to a ranked diagnosis.
+
+    python -m gol_distributed_final_tpu.obs.doctor tcp://127.0.0.1:8040
+    python -m gol_distributed_final_tpu.obs.doctor :8040 \\
+        -worker :8030 -worker :8031 -out out
+
+PR 4/6 made failures *detectable* (worker_health, quarantine backoff,
+integrity counters, flight events); this CLI makes them *explained*: it
+pulls ``Status`` from the broker and any workers, correlates timelines,
+flight rings, span statistics, worker health, and active SLO alerts into
+a ranked finding list ("worker :8041 quarantined 3x, resync counter
+climbing, wire bytes/turn 12x baseline -> suspect flapping transport"),
+prints a terminal report, and writes ``out/doctor_<ts>.json`` so the
+diagnosis is an artifact, not scrollback.
+
+Built ENTIRELY on the read-only Status surface (the obs/watch.py
+posture): attachable to a live, degraded, or wedged cluster; every
+payload read goes through ``dict.get`` so version skew renders a gap,
+never a crash. The correlation core (``diagnose``) is a pure function of
+the fetched payloads — unit-testable on canned multi-process fixtures.
+
+``--selfcheck`` spins a loopback broker in-process, runs a tiny job,
+polls and diagnoses it, and fails on an empty or unrenderable diagnosis
+— the ``scripts/check --doctor`` smoke gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .status import StatusUnavailable, fetch_status
+from .status import scalar_value as _scalar
+from .status import series_map as _series_map
+
+SCHEMA = "gol-doctor/1"
+
+_SEVERITY_ORDER = {"page": 0, "warn": 1, "info": 2}
+
+
+def _norm_addr(address: str) -> str:
+    """Accept ``tcp://host:port``, ``host:port``, and ``:port``."""
+    if address.startswith("tcp://"):
+        address = address[len("tcp://"):]
+    if address.startswith(":"):
+        address = "127.0.0.1" + address
+    return address
+
+
+def collect(
+    broker: str, workers: List[str], timeout: float = 5.0
+) -> Dict[str, dict]:
+    """One Status poll per target. Failed polls become ``{"error": ...}``
+    entries — a dead worker is EVIDENCE, not a fetch failure."""
+    statuses: Dict[str, dict] = {}
+    targets = [(f"broker {_norm_addr(broker)}", _norm_addr(broker), False)]
+    targets += [
+        (f"worker {_norm_addr(w)}", _norm_addr(w), True) for w in workers
+    ]
+    for label, addr, is_worker in targets:
+        try:
+            statuses[label] = fetch_status(
+                addr, worker=is_worker, timeout=timeout
+            )
+        except StatusUnavailable as exc:
+            statuses[label] = {"error": f"no status: {exc}"}
+        except Exception as exc:
+            statuses[label] = {"error": f"poll failed: {exc}"}
+    return statuses
+
+
+def _label_total(snap: dict, name: str) -> Tuple[float, Dict[str, float]]:
+    """(sum across label children, {label0: value}) for one counter."""
+    by = {}
+    for labels, s in _series_map(snap, name).items():
+        v = s.get("value") or 0.0
+        if v:
+            by[labels[0] if labels else "?"] = v
+    return sum(by.values()), by
+
+
+def _finding(severity: str, score: float, title: str, detail: str,
+             evidence: List[str], suspects: List[str],
+             target: str) -> dict:
+    return {
+        "severity": severity,
+        "score": round(score, 2),
+        "title": title,
+        "detail": detail,
+        "evidence": evidence,
+        "suspects": sorted(set(suspects)),
+        "target": target,
+    }
+
+
+# -- correlation heuristics (each: payloads -> findings) ---------------------
+
+
+def _flight_counts(payload: dict, kind: str) -> Dict[str, int]:
+    """Occurrences of one flight-event kind by name (e.g. how many times
+    each worker address appears in ``worker.lost`` events)."""
+    out: Dict[str, int] = {}
+    for ev in payload.get("flight") or []:
+        if ev.get("kind") == kind:
+            name = str(ev.get("name", "?"))
+            out[name] = out.get(name, 0) + 1
+    return out
+
+
+def _find_unreachable(statuses) -> List[dict]:
+    out = []
+    for label, payload in statuses.items():
+        if "error" in payload:
+            sev = "page" if label.startswith("broker") else "warn"
+            out.append(_finding(
+                sev, 100.0 if sev == "page" else 60.0,
+                f"{label} unreachable",
+                str(payload["error"]),
+                [f"Status poll failed: {payload['error']}"],
+                [label.split(" ", 1)[-1]],
+                label,
+            ))
+    return out
+
+
+def _find_lost_workers(statuses) -> List[dict]:
+    """The flapping-transport correlation: roster health + per-address
+    loss/quarantine history + resync + wire-byte amplification."""
+    out = []
+    for label, payload in statuses.items():
+        roster = payload.get("workers") or []
+        lost = [w for w in roster if w.get("state") == "lost"]
+        if not lost:
+            continue
+        snap = payload.get("metrics") or {}
+        loss_events = _flight_counts(payload, "worker.lost")
+        readmits = _scalar(snap, "gol_worker_readmitted_total") or 0
+        resyncs = _scalar(snap, "gol_strip_resync_total") or 0
+        retries = _scalar(snap, "gol_turn_retry_total") or 0
+        turns = _scalar(snap, "gol_engine_turns_total")
+        wire_total, _ = _label_total(snap, "gol_wire_bytes_total")
+        for w in lost:
+            addr = w.get("address", "?")
+            losses = loss_events.get(addr, 0)
+            evidence = [f"roster marks {addr} lost"]
+            retry = w.get("retry_in_s")
+            if retry is not None:
+                evidence.append(f"next readmission probe in {retry}s")
+            if losses:
+                evidence.append(
+                    f"flight ring shows {losses} loss event(s) for {addr}"
+                )
+            if readmits:
+                evidence.append(f"{int(readmits)} readmission(s) so far")
+            if resyncs:
+                evidence.append(
+                    f"strip resync counter at {int(resyncs)} and climbing "
+                    "with each loss"
+                )
+            if retries:
+                evidence.append(f"{int(retries)} turn retr(ies) paid")
+            flapping = losses >= 2 or (losses >= 1 and readmits >= 1)
+            if flapping:
+                title = (
+                    f"worker {addr} quarantined {losses}x — suspect "
+                    "flapping transport"
+                )
+                detail = (
+                    "repeat loss/readmit cycles: each readmission taxes "
+                    "the next turn a scatter deadline; the probe backoff "
+                    "is escalating. Check the network path or restart "
+                    "the worker."
+                )
+            else:
+                title = f"worker {addr} lost from the scatter set"
+                detail = (
+                    "the broker re-split its rows over the survivors; "
+                    "the readmission probe is dialling it."
+                )
+            if wire_total and turns:
+                evidence.append(
+                    f"wire bytes/turn currently "
+                    f"{wire_total / max(turns, 1):,.0f}"
+                )
+            out.append(_finding(
+                "page", 90.0 + 5.0 * losses, title, detail,
+                evidence, [addr], label,
+            ))
+    return out
+
+
+def _find_alerts(statuses) -> List[dict]:
+    out = []
+    for label, payload in statuses.items():
+        for alert in payload.get("alerts") or []:
+            if alert.get("state") != "firing":
+                continue
+            sev = alert.get("severity", "warn")
+            if sev not in _SEVERITY_ORDER:
+                sev = "warn"
+            since = alert.get("since_unix")
+            age = (
+                f"for {time.time() - since:.0f}s"
+                if isinstance(since, (int, float)) and since else "now"
+            )
+            out.append(_finding(
+                sev, 80.0 if sev == "page" else 50.0,
+                f"SLO rule '{alert.get('rule', '?')}' firing {age}",
+                str(alert.get("detail", "")),
+                [f"server-side evaluation: {alert.get('detail', '')}"],
+                [], label,
+            ))
+    return out
+
+
+def _find_integrity(statuses) -> List[dict]:
+    out = []
+    for label, payload in statuses.items():
+        snap = payload.get("metrics") or {}
+        total, by_kind = _label_total(snap, "gol_integrity_failures_total")
+        if not total:
+            continue
+        kinds = ", ".join(f"{k} {int(v)}" for k, v in sorted(by_kind.items()))
+        suspects = sorted(_flight_counts(payload, "integrity.fail"))
+        out.append(_finding(
+            "page", 95.0,
+            f"{int(total)} integrity failure(s) caught ({kinds})",
+            "corrupted data was DETECTED and quarantined, never served; "
+            "the suspect worker(s) were routed through loss recovery.",
+            [f"gol_integrity_failures_total{{{kinds}}}"]
+            + [f"flight names suspect {s}" for s in suspects],
+            suspects, label,
+        ))
+    return out
+
+
+def _find_error_ratio(statuses) -> List[dict]:
+    out = []
+    for label, payload in statuses.items():
+        snap = payload.get("metrics") or {}
+        errs, by_verb = _label_total(snap, "gol_rpc_server_errors_total")
+        reqs, _ = _label_total(snap, "gol_rpc_server_requests_total")
+        if not reqs or not errs:
+            continue
+        ratio = errs / reqs
+        if ratio <= 0.01:
+            continue
+        verbs = ", ".join(
+            f"{k.rsplit('.', 1)[-1]} {int(v)}"
+            for k, v in sorted(by_verb.items())
+        )
+        out.append(_finding(
+            "warn", 55.0 + min(30.0, 100.0 * ratio),
+            f"RPC error ratio {100 * ratio:.1f}% ({int(errs)}/{int(reqs)})",
+            f"error replies by verb: {verbs}",
+            [f"gol_rpc_server_errors_total / _requests_total = {ratio:.4f}"],
+            [], label,
+        ))
+    return out
+
+
+def _rate_from_timeline(payload: dict, metric: str) -> Optional[float]:
+    """The server-computed rate for one summary entry. The summary DROPS
+    zero-increase counters (obs/timeline.py keeps it small), so when the
+    timeline payload exists but the entry is absent, the truthful answer
+    is 0.0 — exactly the stalled case; None only when the server ships
+    no timeline at all (can't judge)."""
+    tl = payload.get("timeline")
+    if not isinstance(tl, dict):
+        return None
+    entry = (tl.get("summary") or {}).get(metric)
+    if isinstance(entry, dict):
+        return entry.get("rate_per_s")
+    return 0.0
+
+
+def _find_stall(statuses) -> List[dict]:
+    """A process whose turn counters have history but a ~zero recent
+    rate: wedged or starved, the flight tail names its last act."""
+    out = []
+    for label, payload in statuses.items():
+        snap = payload.get("metrics") or {}
+        turns = _scalar(snap, "gol_engine_turns_total")
+        if not turns:
+            continue
+        rate = _rate_from_timeline(payload, "gol_engine_turns_total")
+        if rate is None or rate > 0.01:
+            continue
+        tail = [
+            f"last act: {ev.get('kind', '?')} {ev.get('name', '?')}"
+            for ev in (payload.get("flight") or [])[-3:]
+        ]
+        out.append(_finding(
+            "warn", 65.0,
+            f"turn counter stalled at {int(turns)}",
+            "the engine evolved turns earlier but the server-side "
+            "timeline shows a ~zero recent rate — wedged, paused, or "
+            "the run ended.",
+            [f"timeline rate {rate:.4f} turns/s over the summary window"]
+            + tail,
+            [], label,
+        ))
+    return out
+
+
+def _find_hbm(statuses) -> List[dict]:
+    out = []
+    for label, payload in statuses.items():
+        snap = payload.get("metrics") or {}
+        in_use = _series_map(snap, "gol_device_hbm_bytes_in_use")
+        limits = _series_map(snap, "gol_device_hbm_bytes_limit")
+        for labels, s in in_use.items():
+            used = s.get("value") or 0
+            cap = (limits.get(labels) or {}).get("value") or 0
+            if cap and used / cap > 0.9:
+                dev = labels[0] if labels else "?"
+                out.append(_finding(
+                    "warn", 70.0,
+                    f"device {dev} HBM at {100 * used / cap:.0f}%",
+                    "the next admission or chunk growth may OOM; shrink "
+                    "-session-capacity or the board.",
+                    [f"gol_device_hbm_bytes_in_use {used:.3g} / {cap:.3g}"],
+                    [], label,
+                ))
+    return out
+
+
+def _find_checkpoint(statuses) -> List[dict]:
+    out = []
+    for label, payload in statuses.items():
+        snap = payload.get("metrics") or {}
+        errs = _scalar(snap, "gol_engine_checkpoint_errors_total") or 0
+        ck = _series_map(snap, "gol_ckpt_verify_total")
+        bad = (ck.get(("fail",)) or {}).get("value") or 0
+        if not errs and not bad:
+            continue
+        evidence = []
+        if errs:
+            evidence.append(f"{int(errs)} periodic checkpoint write failure(s)")
+        if bad:
+            evidence.append(f"{int(bad)} checkpoint digest verification failure(s)")
+        out.append(_finding(
+            "warn", 60.0,
+            "checkpoint trouble: crash-recovery coverage is degraded",
+            "the run continues, but a crash now may lose more turns than "
+            "-auto-checkpoint promises; check disk space and the "
+            "-ckpt-keep generations.",
+            evidence, [], label,
+        ))
+    return out
+
+
+_HEURISTICS = (
+    _find_unreachable,
+    _find_lost_workers,
+    _find_integrity,
+    _find_alerts,
+    _find_error_ratio,
+    _find_stall,
+    _find_hbm,
+    _find_checkpoint,
+)
+
+
+def diagnose(statuses: Dict[str, dict]) -> List[dict]:
+    """The correlation core: pure function of the fetched payloads.
+    Returns findings ranked severity-then-score, deduplicated by
+    (severity, title); ALWAYS non-empty — a clean bill of health is
+    itself a finding (the smoke gate's renderable-diagnosis contract)."""
+    findings: List[dict] = []
+    seen = set()
+    for heuristic in _HEURISTICS:
+        try:
+            batch = heuristic(statuses)
+        except Exception as exc:  # a probe bug must not sink the triage
+            batch = [_finding(
+                "info", 0.0, f"heuristic {heuristic.__name__} failed",
+                str(exc), [], [], "-",
+            )]
+        for f in batch:
+            key = (f["severity"], f["title"])
+            if key not in seen:
+                seen.add(key)
+                findings.append(f)
+    if not findings:
+        polled = sum(1 for p in statuses.values() if "error" not in p)
+        findings.append(_finding(
+            "info", 0.0, "no anomalies detected",
+            f"{polled}/{len(statuses)} target(s) answered Status; no lost "
+            "workers, no firing alerts, no integrity failures, no error "
+            "ratio past 1%.",
+            [], [], "-",
+        ))
+    findings.sort(
+        key=lambda f: (_SEVERITY_ORDER.get(f["severity"], 9), -f["score"])
+    )
+    for rank, f in enumerate(findings, 1):
+        f["rank"] = rank
+    return findings
+
+
+def render(findings: List[dict], statuses: Dict[str, dict]) -> str:
+    """Terminal report — pure function of the diagnosis (testable without
+    a cluster, the obs/watch.py renderer posture)."""
+    stamp = time.strftime("%Y-%m-%d %H:%M:%S")
+    lines = [f"gol doctor — {stamp}   ({len(statuses)} target(s) polled)"]
+    for label, payload in statuses.items():
+        state = (
+            f"UNREACHABLE — {payload['error']}"
+            if "error" in payload
+            else f"ok (pid {payload.get('pid', '?')}"
+            + (
+                "" if payload.get("metrics_enabled")
+                else ", metrics DISABLED"
+            )
+            + ")"
+        )
+        lines.append(f"  {label}: {state}")
+    lines.append("")
+    for f in findings:
+        lines.append(
+            f"#{f['rank']} [{f['severity'].upper()}] {f['title']}"
+        )
+        if f.get("detail"):
+            lines.append(f"    {f['detail']}")
+        for e in f.get("evidence", []):
+            lines.append(f"    - {e}")
+        if f.get("suspects"):
+            lines.append(f"    suspects: {', '.join(f['suspects'])}")
+        lines.append("")
+    return "\n".join(lines).rstrip() + "\n"
+
+
+def write_report(
+    findings: List[dict], statuses: Dict[str, dict], out_dir="out"
+) -> pathlib.Path:
+    """``out/doctor_<ts>.json``: diagnosis + per-target identity (NOT the
+    full payloads — flight rings and timelines would bloat the artifact;
+    the evidence strings carry what mattered). Temp-name + atomic rename
+    like every other artifact writer."""
+    path = pathlib.Path(out_dir) / f"doctor_{int(time.time())}.json"
+    path.parent.mkdir(parents=True, exist_ok=True)
+    targets = {}
+    for label, payload in statuses.items():
+        if "error" in payload:
+            targets[label] = {"error": payload["error"]}
+        else:
+            targets[label] = {
+                "pid": payload.get("pid"),
+                "role": payload.get("role"),
+                "metrics_enabled": payload.get("metrics_enabled"),
+                "firing_alerts": [
+                    a.get("rule") for a in payload.get("alerts") or []
+                    if a.get("state") == "firing"
+                ],
+            }
+    report = {
+        "schema": SCHEMA,
+        "generated_unix": time.time(),
+        "targets": targets,
+        "findings": findings,
+    }
+    tmp = path.with_name(path.name + ".tmp")
+    tmp.write_text(json.dumps(report, indent=1, default=str))
+    tmp.replace(path)
+    return path
+
+
+def _selfcheck(out_dir: str) -> int:
+    """The ``scripts/check --doctor`` smoke: loopback broker, tiny run,
+    poll + diagnose + render + write, fail on empty/unrenderable."""
+    import numpy as np
+
+    from ..obs import metrics as _metrics
+    from ..obs import timeline as _timeline
+    from ..rpc.broker import serve
+    from ..rpc.client import RpcClient
+    from ..rpc.protocol import Methods, Request
+
+    _metrics.enable()
+    _timeline.enable(period=0.1)
+    server, _service = serve(port=0)
+    try:
+        addr = f"127.0.0.1:{server.port}"
+        rng = np.random.default_rng(7)
+        board = np.where(rng.random((64, 64)) < 0.3, 255, 0).astype(np.uint8)
+        client = RpcClient(addr)
+        try:
+            client.call(
+                Methods.BROKER_RUN,
+                Request(world=board, turns=8, image_width=64,
+                        image_height=64, threads=1),
+                timeout=120.0,
+            )
+        finally:
+            client.close()
+        time.sleep(0.3)  # at least two sampler ticks land
+        statuses = collect(addr, [])
+        findings = diagnose(statuses)
+        text = render(findings, statuses)
+        path = write_report(findings, statuses, out_dir)
+        sys.stdout.write(text)
+        tl = statuses.get(f"broker {addr}", {}).get("timeline") or {}
+        if not findings or not text.strip():
+            print("doctor selfcheck FAILED: empty diagnosis", file=sys.stderr)
+            return 1
+        if not tl.get("series"):
+            print(
+                "doctor selfcheck FAILED: broker shipped no timeline window",
+                file=sys.stderr,
+            )
+            return 1
+        print(f"doctor selfcheck ok: report at {path}")
+        return 0
+    finally:
+        _timeline.disable()
+        server.stop()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="one-shot cluster triage over the read-only Status verb"
+    )
+    parser.add_argument(
+        "address", nargs="?", default=None,
+        help="broker host:port (tcp:// prefix and :port shorthand accepted)",
+    )
+    parser.add_argument(
+        "-worker", action="append", default=[], metavar="HOST:PORT",
+        help="also poll and correlate this worker's Status (repeatable)",
+    )
+    parser.add_argument(
+        "-timeout", type=float, default=5.0, metavar="SECONDS",
+        help="per-target poll bound (default 5); an unreachable target "
+             "becomes evidence, not a hang",
+    )
+    parser.add_argument(
+        "-out", default="out", metavar="DIR",
+        help="directory for doctor_<ts>.json (default out)",
+    )
+    parser.add_argument(
+        "-json", action="store_true",
+        help="print the JSON report to stdout instead of the terminal text",
+    )
+    parser.add_argument(
+        "--selfcheck", action="store_true",
+        help="spin a loopback broker, run a tiny job, diagnose it, and "
+             "fail on an empty diagnosis (the scripts/check --doctor gate)",
+    )
+    args = parser.parse_args(argv)
+    if args.selfcheck:
+        return _selfcheck(args.out)
+    if not args.address:
+        parser.error("an address is required (or --selfcheck)")
+    statuses = collect(args.address, args.worker, timeout=args.timeout)
+    findings = diagnose(statuses)
+    path = write_report(findings, statuses, args.out)
+    if args.json:
+        print(json.dumps(
+            {"findings": findings, "report_path": str(path)},
+            indent=1, default=str,
+        ))
+    else:
+        sys.stdout.write(render(findings, statuses))
+        print(f"report written to {path}")
+    broker_label = next(iter(statuses), None)
+    broker_ok = broker_label is not None and "error" not in statuses[broker_label]
+    return 0 if broker_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -178,6 +178,48 @@ SESSION_TURNS_TOTAL = _R.counter(
     "batched dispatch adds k x active universes).",
 )
 
+# -- serving SLOs (obs/timeline.py sampler, obs/slo.py rules) ---------------
+
+SESSION_TURN_SECONDS = _R.histogram(
+    "gol_session_turn_seconds",
+    "Per-universe-turn serving latency of the batched session driver "
+    "(engine/sessions.py): each k-turn batched dispatch records its wall "
+    "normalized per universe-turn, count == universe-turns — the "
+    "latency objective the 'session-turn-latency' SLO rule evaluates.",
+)
+SESSION_ADMIT_WAIT_SECONDS = _R.histogram(
+    "gol_session_admit_wait_seconds",
+    "SessionRun admission latency (rpc/broker.SessionScheduler.submit "
+    "entry to the session joining the table) — the 'session-admit-"
+    "latency' SLO rule's feed; growth means the driver thread is "
+    "starved or the table lock is contended.",
+)
+RPC_DISPATCH_SECONDS = _R.histogram(
+    "gol_rpc_dispatch_seconds",
+    "Inbound RPC HANDLER time only (fn(request) inside the dispatch, "
+    "excluding frame parse and reply serialisation — "
+    "gol_rpc_server_request_seconds covers the whole dispatch), by verb "
+    "— the 'rpc-dispatch-latency' SLO rule's feed. Verbs that BLOCK by "
+    "contract (Run, SessionRun: rpc/protocol.BLOCKING_METHODS) are "
+    "excluded; their handler wall is the run length, not a latency.",
+    labelnames=("method",),
+)
+SCATTER_DEADLINE_SECONDS = _R.gauge(
+    "gol_scatter_deadline_seconds",
+    "The workers backend's current per-scatter reply deadline (pinned "
+    "by -rpc-deadline, else adaptive ~20x the turn-time EWMA): the "
+    "'scatter-deadline-growth' SLO rule alerts on its drift — the "
+    "cluster getting slower before anything has failed.",
+)
+SLO_ALERTS_TOTAL = _R.counter(
+    "gol_slo_alerts_total",
+    "SLO rule firings (obs/slo.py RuleBook transitions to firing), by "
+    "rule name and severity (page/warn). Active alert STATE lives in "
+    "the Status payload's 'alerts' field; this counter is the "
+    "scrape-able history.",
+    labelnames=("rule", "severity"),
+)
+
 # -- data integrity (rpc/integrity.py: checked frames, attestation,
 #    verified checkpoints) ---------------------------------------------------
 

@@ -71,6 +71,8 @@ _REQUIRED_SECTIONS = (
     "Wire modes",
     "Integrity",
     "Sessions",
+    "SLOs & alerting",
+    "## Doctor",
 )
 
 # the wire data-plane metric families (rpc/protocol.py frames + the
@@ -162,6 +164,38 @@ def undocumented_wire_metrics(readme_path=None) -> List[str]:
     return sorted(n for n in _WIRE_METRIC_NAMES if n not in section)
 
 
+# the serving-SLO metric families (obs/timeline.py sampler + obs/slo.py
+# rules + their instrument feeds): these must be documented in the
+# README's "SLOs & alerting" section specifically — the operator
+# contract for the alerting surface
+_SLO_METRIC_NAMES = (
+    "gol_session_turn_seconds",
+    "gol_session_admit_wait_seconds",
+    "gol_rpc_dispatch_seconds",
+    "gol_scatter_deadline_seconds",
+    "gol_slo_alerts_total",
+)
+
+
+def undocumented_slo_metrics(readme_path=None) -> List[str]:
+    """SLO metric names missing from the README's "SLOs & alerting"
+    section specifically (the wire/device-table posture: a name
+    mentioned elsewhere in the file does not count as documented
+    here)."""
+    section = _readme_section(readme_path, "## SLOs & alerting")
+    return sorted(n for n in _SLO_METRIC_NAMES if n not in section)
+
+
+def undocumented_slo_rules(readme_path=None) -> List[str]:
+    """Default SLO rule names (obs/slo.DEFAULT_RULE_NAMES — the stable
+    alert-identity contract, the ``gol_slo_alerts_total{rule}`` label
+    set) missing from the README's "SLOs & alerting" section."""
+    from .slo import DEFAULT_RULE_NAMES
+
+    section = _readme_section(readme_path, "## SLOs & alerting")
+    return sorted(n for n in DEFAULT_RULE_NAMES if n not in section)
+
+
 def missing_readme_sections(readme_path=None) -> List[str]:
     """Required operator-facing README sections that are absent."""
     if readme_path is None:
@@ -210,6 +244,20 @@ def main(argv=None) -> int:
             "session metrics missing from README.md's Sessions section:",
             "session-metric lint ok: every session metric is in the "
             "Sessions section",
+        ),
+        (
+            undocumented_slo_metrics,
+            "SLO metrics missing from README.md's SLOs & alerting "
+            "section:",
+            "slo-metric lint ok: every SLO metric is in the SLOs & "
+            "alerting section",
+        ),
+        (
+            undocumented_slo_rules,
+            "default SLO rule names missing from README.md's SLOs & "
+            "alerting section:",
+            "slo-rule lint ok: every default rule name is in the SLOs & "
+            "alerting section",
         ),
         (
             missing_readme_sections,

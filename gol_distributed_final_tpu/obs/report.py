@@ -21,12 +21,12 @@ import pathlib
 import time
 from typing import Optional
 
-from . import flight, metrics, tracing
+from . import flight, metrics, timeline, tracing
 
 SCHEMA = "gol-run-report/1"
 
 
-def status_payload(**extra) -> dict:
+def status_payload(timeline_since: int = 0, **extra) -> dict:
     """The ``Status`` verb's reply body: registry snapshot + identity.
 
     Deliberately jax-free: a worker process that never imported jax must
@@ -36,7 +36,14 @@ def status_payload(**extra) -> dict:
     With tracing on, the payload also carries the span ring (the material
     a controller's Chrome-trace export is built from) and the flight
     recorder's last-events ring — so a WEDGED process can be post-mortemed
-    live over one read-only RPC."""
+    live over one read-only RPC.
+
+    With the timeline sampler on (``-timeline``), the payload carries an
+    INCREMENTAL metric-timeline window — only samples past the caller's
+    ``timeline_since`` seq (the ``Request.timeline_since`` extension
+    field), with server-computed rates/quantiles in its ``summary`` —
+    plus the SLO rulebook's alert states (obs/slo.py), so one poll sees
+    cluster health without client-side reconstruction."""
     reg = metrics.registry()
     payload = {
         "schema": "gol-status/1",
@@ -49,6 +56,15 @@ def status_payload(**extra) -> dict:
         payload["trace_spans"] = tracing.tracer().snapshot()
     if flight.enabled():
         payload["flight"] = flight.recorder().snapshot()
+    sampler = timeline.sampler()
+    if sampler is not None:
+        # opportunistic tick: a GIL-saturated (or just-started) process
+        # whose background thread has not run still answers the poll
+        # with a due sample instead of a stale ring
+        sampler.maybe_sample()
+        payload["timeline"] = sampler.window(since=timeline_since)
+        if sampler.rulebook is not None:
+            payload["alerts"] = sampler.rulebook.snapshot()
     payload.update(extra)
     return payload
 
@@ -150,6 +166,19 @@ def write_run_report(
         "metrics": snap,
         "stage_timings": stage_timings(snap),
     }
+    sampler = timeline.sampler()
+    if sampler is not None:
+        # the run-health verdict rides in the final artifact: a timeline
+        # summary (rate/mean/p50/p99 per active series) plus every SLO
+        # rule's state and fire count — "was this run healthy" without
+        # replaying logs
+        report["timeline"] = sampler.summary()
+        if sampler.rulebook is not None:
+            alerts = sampler.rulebook.snapshot()
+            report["alerts"] = alerts
+            report["alerts_fired"] = sorted(
+                a["rule"] for a in alerts if a.get("fired_total")
+            )
     if extra:
         report.update(extra)
     path = report_path(params, out_dir)

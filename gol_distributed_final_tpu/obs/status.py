@@ -14,6 +14,24 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+from typing import Dict, Optional
+
+
+def series_map(snap: dict, name: str) -> Dict[tuple, dict]:
+    """``{labels_tuple: series_dict}`` for one family of a registry
+    snapshot — the skew-safe reader every Status consumer (obs/watch.py
+    panels, obs/doctor.py heuristics) shares: an absent family reads as
+    empty, never a KeyError."""
+    for fam in snap.get("families", []):
+        if fam.get("name") == name:
+            return {tuple(s.get("labels", ())): s for s in fam.get("series", [])}
+    return {}
+
+
+def scalar_value(snap: dict, name: str, labels: tuple = ()) -> Optional[float]:
+    """One series' value from a snapshot, or None when absent."""
+    s = series_map(snap, name).get(labels)
+    return None if s is None else s.get("value")
 
 
 class StatusUnavailable(RuntimeError):
@@ -46,8 +64,18 @@ def extract_status(res) -> dict:
     return status
 
 
-def fetch_status(address: str, worker: bool = False, timeout: float = 10.0) -> dict:
+def fetch_status(
+    address: str,
+    worker: bool = False,
+    timeout: float = 10.0,
+    timeline_since: int = 0,
+) -> dict:
     """One Status round-trip against a broker (default) or worker.
+
+    ``timeline_since`` echoes the last timeline seq this poller received
+    (``payload["timeline"]["seq"]``) so a ``-timeline`` server ships
+    only NEWER samples — the incremental-window contract; 0 asks for the
+    full ring, and a pre-timeline server ignores the field entirely.
 
     Raises ``StatusUnavailable`` (with a mode-specific message, see
     ``extract_status``) instead of returning an empty dict, so callers
@@ -63,7 +91,7 @@ def fetch_status(address: str, worker: bool = False, timeout: float = 10.0) -> d
         # wedged server must fail this poller, never hang it
         res = client.call(
             Methods.WORKER_STATUS if worker else Methods.STATUS,
-            Request(),
+            Request(timeline_since=timeline_since),
             timeout=timeout,
         )
     finally:

@@ -281,7 +281,7 @@ def current_ctx() -> Optional[dict]:
 # -- Chrome trace-event export -----------------------------------------------
 
 
-def to_chrome_trace(spans: Iterable[dict]) -> dict:
+def to_chrome_trace(spans: Iterable[dict], counters: Iterable[dict] = ()) -> dict:
     """Render span records (from any number of processes) as a Chrome
     trace-event JSON object Perfetto accepts: one ``ph: "X"`` complete
     event per span (``ts``/``dur`` in microseconds — ``ts`` is wall-clock
@@ -292,7 +292,13 @@ def to_chrome_trace(spans: Iterable[dict]) -> dict:
     DIFFERENT hosts can share an os.getpid(), and a cross-host span set
     (collect_remote_spans) must not interleave them on one track. Each
     distinct process gets a synthetic track id; the real pid rides in the
-    span args."""
+    span args.
+
+    ``counters`` are metric-timeline samples (obs/timeline.py
+    ``chrome_counter_samples``: ``{"name", "ts_us", "value"}`` dicts),
+    rendered as ``ph: "C"`` counter events on one dedicated "metrics
+    timeline" track — so Perfetto shows throughput/HBM/queue depth on
+    the SAME timeline as the spans."""
     spans = list(spans)
     track_ids: Dict[tuple, int] = {}
     roles: Dict[tuple, str] = {}
@@ -330,6 +336,20 @@ def to_chrome_trace(spans: Iterable[dict]) -> dict:
             "name": "process_name", "ph": "M", "ts": 0, "pid": track,
             "tid": 0, "args": {"name": roles[key]},
         })
+    counters = list(counters or ())
+    if counters:
+        counter_track = len(track_ids) + 1
+        for c in counters:
+            events.append({
+                "name": str(c["name"]), "ph": "C",
+                "ts": int(c["ts_us"]), "pid": counter_track, "tid": 0,
+                "args": {"value": float(c["value"])},
+            })
+        events.append({
+            "name": "process_name", "ph": "M", "ts": 0,
+            "pid": counter_track, "tid": 0,
+            "args": {"name": "metrics timeline"},
+        })
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
@@ -338,13 +358,16 @@ def trace_path(params, out_dir="out") -> pathlib.Path:
     return pathlib.Path(out_dir) / f"trace_{params.output_filename}.json"
 
 
-def write_chrome_trace(path, spans: Iterable[dict]) -> pathlib.Path:
-    """Dump spans as Chrome trace JSON, via temp-name + atomic rename like
-    the checkpoint and report writers."""
+def write_chrome_trace(
+    path, spans: Iterable[dict], counters: Iterable[dict] = ()
+) -> pathlib.Path:
+    """Dump spans (plus optional timeline counter samples — see
+    ``to_chrome_trace``) as Chrome trace JSON, via temp-name + atomic
+    rename like the checkpoint and report writers."""
     path = pathlib.Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     tmp = path.with_name(path.name + ".tmp")
-    tmp.write_text(json.dumps(to_chrome_trace(spans)))
+    tmp.write_text(json.dumps(to_chrome_trace(spans, counters)))
     tmp.replace(path)
     return path
 

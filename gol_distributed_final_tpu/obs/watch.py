@@ -31,23 +31,11 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from .status import StatusUnavailable, fetch_status
+from .status import scalar_value as _scalar
+from .status import series_map as _series_map
+from .timeline import counter_delta
 
 _CLEAR = "\x1b[2J\x1b[H"
-
-
-# -- snapshot readers (all skew-safe: absent families render as gaps) --------
-
-
-def _series_map(snap: dict, name: str) -> Dict[tuple, dict]:
-    for fam in snap.get("families", []):
-        if fam.get("name") == name:
-            return {tuple(s.get("labels", ())): s for s in fam.get("series", [])}
-    return {}
-
-
-def _scalar(snap: dict, name: str, labels: tuple = ()) -> Optional[float]:
-    s = _series_map(snap, name).get(labels)
-    return None if s is None else s.get("value")
 
 
 def _hist_stats(series: dict) -> Tuple[int, float]:
@@ -75,6 +63,87 @@ def _human_seconds(s: float) -> str:
 
 
 # -- panel renderers ---------------------------------------------------------
+
+
+def _alert_lines(payload: dict) -> List[str]:
+    """The SLO panel (obs/slo.py states shipped in the Status payload):
+    firing alerts are the headline — rule, severity, age, and the
+    server-side evaluation detail. All-ok rulebooks render one quiet
+    summary line; servers without ``-timeline`` render nothing."""
+    alerts = payload.get("alerts")
+    if not alerts:
+        return []
+    firing = [a for a in alerts if a.get("state") == "firing"]
+    if not firing:
+        fired = sum(int(a.get("fired_total") or 0) for a in alerts)
+        line = f"  {len(alerts)} rules evaluated, none firing"
+        if fired:
+            line += f"   ({fired} past firing(s) — see flight ring)"
+        return ["ALERTS (slo rulebook ok)", line]
+    now = time.time()
+    out = [f"ALERTS — {len(firing)} FIRING"]
+    for a in firing:
+        since = a.get("since_unix")
+        age = (
+            f"{now - since:6.0f}s"
+            if isinstance(since, (int, float)) and since
+            else "     ?"
+        )
+        out.append(
+            f"  ** {str(a.get('severity', '?')).upper():<4} "
+            f"{a.get('rule', '?'):<24} for {age}   "
+            f"{a.get('detail', '')}"
+        )
+    return out
+
+
+# summary entries worth a dashboard line, in render order (the rest stay
+# pollable via obs/status -format json)
+_TIMELINE_KEYS = (
+    "gol_engine_turns_total",
+    "gol_session_turns_total",
+    "gol_session_turn_seconds",
+    "gol_session_admit_wait_seconds",
+    "gol_rpc_dispatch_seconds{method=Operations.SessionRun}",
+    "gol_rpc_server_errors_total",
+    "gol_scatter_deadline_seconds",
+)
+
+
+def _timeline_lines(payload: dict) -> List[str]:
+    """Server-computed rates/quantiles (obs/timeline.py summary): unlike
+    the client-side counter-delta rates elsewhere on this dashboard,
+    these survive dashboard restarts and are exactly what the SLO rules
+    evaluated."""
+    tl = payload.get("timeline") or {}
+    summary = tl.get("summary") or {}
+    if not summary:
+        return []
+    out = [
+        f"TIMELINE (server-side, last {int(tl.get('summary_window_s') or 0)}s"
+        f" @ {tl.get('period_s', '?')}s cadence)"
+    ]
+    shown = 0
+    for key in _TIMELINE_KEYS:
+        entry = summary.get(key)
+        if not isinstance(entry, dict):
+            continue
+        parts = [f"  {key:<44}"]
+        rate = entry.get("rate_per_s")
+        if rate is not None:
+            parts.append(f"{rate:,.1f}/s")
+        if entry.get("p99_s") is not None:
+            parts.append(
+                f"p50 {_human_seconds(entry.get('p50_s') or 0)}"
+                f"  p99 {_human_seconds(entry['p99_s'])}"
+            )
+        elif "value" in entry:
+            parts.append(f"now {entry['value']:,.3g}")
+        out.append("  ".join(parts))
+        shown += 1
+    if shown == 0:
+        out.append(f"  {len(summary)} active series (none on the dashboard shortlist)")
+    return out
 
 
 def _throughput_lines(snap: dict, rate: Optional[float]) -> List[str]:
@@ -335,7 +404,9 @@ def render_status(
         head += "   [metrics DISABLED — start the server with -metrics]"
     snap = payload.get("metrics") or {}
     sections = [
+        _alert_lines(payload),
         _throughput_lines(snap, turns_rate),
+        _timeline_lines(payload),
         _rpc_lines(snap),
         _wire_lines(snap),
         _session_lines(snap),
@@ -361,6 +432,9 @@ class Watcher:
         self.targets = [(broker, False)] + [(w, True) for w in workers]
         self.timeout = timeout
         self._prev: Dict[str, Tuple[float, float]] = {}  # addr -> (t, turns)
+        # addr -> last timeline seq received: echoed back so a -timeline
+        # server ships incremental windows instead of the whole ring
+        self._tl_seq: Dict[str, int] = {}
 
     def _turns_rate(self, addr: str, payload: dict) -> Optional[float]:
         now = time.monotonic()
@@ -372,7 +446,11 @@ class Watcher:
             return None
         t0, turns0 = prev
         dt = now - t0
-        return (turns - turns0) / dt if dt > 0 else None
+        # counter_delta (obs/timeline.py — the server rings' reset logic,
+        # shared): a broker or worker restarted between polls reports a
+        # SMALLER total, and the raw subtraction used to render that as a
+        # negative/garbage rate; reset-aware, the new total IS the delta
+        return counter_delta(turns0, turns) / dt if dt > 0 else None
 
     def frame(self) -> Tuple[str, bool]:
         """(rendered frame, primary target ok)."""
@@ -383,8 +461,12 @@ class Watcher:
             kind = "worker" if is_worker else "broker"
             try:
                 payload = fetch_status(
-                    addr, worker=is_worker, timeout=self.timeout
+                    addr, worker=is_worker, timeout=self.timeout,
+                    timeline_since=self._tl_seq.get(addr, 0),
                 )
+                seq = (payload.get("timeline") or {}).get("seq")
+                if isinstance(seq, int):
+                    self._tl_seq[addr] = seq
             except StatusUnavailable as exc:
                 blocks.append(f"== {kind} {addr}: no status — {exc}")
                 continue

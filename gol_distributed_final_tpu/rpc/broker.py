@@ -1083,12 +1083,18 @@ class WorkersBackend:
 
     def _scatter_deadline(self) -> float:
         """Reply bound for one scatter call. ``-rpc-deadline`` pins it;
-        otherwise it adapts to the observed turn time."""
+        otherwise it adapts to the observed turn time. Published on the
+        ``gol_scatter_deadline_seconds`` gauge so the timeline sampler
+        sees the EWMA drift (the 'scatter-deadline-growth' SLO rule:
+        a cluster getting slower before anything has failed)."""
         if self._rpc_deadline:
-            return self._rpc_deadline
-        if self._turn_seconds is None:
-            return _DEADLINE_COLD
-        return max(_DEADLINE_FLOOR, 20.0 * self._turn_seconds + 1.0)
+            deadline = self._rpc_deadline
+        elif self._turn_seconds is None:
+            deadline = _DEADLINE_COLD
+        else:
+            deadline = max(_DEADLINE_FLOOR, 20.0 * self._turn_seconds + 1.0)
+        _ins.SCATTER_DEADLINE_SECONDS.set(deadline)
+        return deadline
 
     def _mark_lost(self, client, reason: str) -> None:
         """Drop a dead/stalled worker: CLOSE its client (a leaked corpse
@@ -1439,6 +1445,10 @@ class SessionScheduler:
         shape = (req.image_height, req.image_width)
         world = np.asarray(req.world, np.uint8)
         tag = getattr(req, "session_id", 0)
+        # admission latency (entry to the session joining the table) —
+        # the 'session-admit-latency' SLO feed: growth means the table
+        # lock is contended or a rejected storm is thrashing it
+        t_admit = time.monotonic()
         with self._work:
             if self._stop:
                 raise RpcError("broker is shutting down")
@@ -1470,6 +1480,9 @@ class SessionScheduler:
                 )
                 self._thread.start()
             self._work.notify_all()
+            _ins.SESSION_ADMIT_WAIT_SECONDS.observe(
+                time.monotonic() - t_admit
+            )
         try:
             sess.done.wait()
         finally:
@@ -1703,11 +1716,16 @@ class BrokerService:
         When tracing is on, the payload also carries this process's span
         ring + flight ring (obs/report.status_payload), and a workers
         backend folds in its workers' spans — one poll sees the whole
-        fan-out topology."""
+        fan-out topology. With ``-timeline`` on, it also ships the
+        incremental metric-timeline window past the caller's
+        ``timeline_since`` seq (getattr: an older client's pickle lacks
+        the field and gets the full ring) plus the SLO alert states."""
         from ..obs.report import status_payload
 
+        since = getattr(req, "timeline_since", 0)
         payload = status_payload(
-            role="broker", backend=type(self.backend).__name__
+            role="broker", backend=type(self.backend).__name__,
+            timeline_since=since if isinstance(since, int) else 0,
         )
         health = getattr(self.backend, "worker_health", None)
         if callable(health):
@@ -1903,6 +1921,16 @@ def main(argv=None) -> None:
              "timings, served live by the read-only Operations.Status verb",
     )
     parser.add_argument(
+        "-timeline", nargs="?", const=1.0, default=None, type=float,
+        metavar="SECS",
+        help="enable the server-side metric timeline (obs/timeline.py): a "
+             "background sampler snapshots every counter/gauge/histogram "
+             "at this cadence (default 1 s) into bounded rings, computes "
+             "rates/p99s server-side, evaluates the SLO rulebook "
+             "(obs/slo.py), and ships incremental windows + alert states "
+             "in Status replies; implies -metrics",
+    )
+    parser.add_argument(
         "-trace", action="store_true", default=False,
         help="enable the span tracer + flight recorder (obs/tracing.py, "
              "obs/flight.py): spans join the calling controller's trace "
@@ -1917,6 +1945,12 @@ def main(argv=None) -> None:
         from ..obs import metrics
 
         metrics.enable()
+    if args.timeline is not None:
+        if args.timeline <= 0:
+            parser.error(f"-timeline SECS must be > 0, got {args.timeline}")
+        from ..obs import timeline
+
+        timeline.enable(period=args.timeline)  # implies metrics.enable()
     if args.trace:
         from ..obs import flight, tracing
 

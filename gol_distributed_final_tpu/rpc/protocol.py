@@ -65,6 +65,16 @@ class Methods:
     SESSION_RUN = "Operations.SessionRun"
 
 
+#: verbs whose handler BLOCKS for the whole game by contract (Run parks
+#: until the run completes, SessionRun until its universe drains): their
+#: handler wall is the run length, not a serving latency, so the
+#: ``gol_rpc_dispatch_seconds`` SLO histogram skips them — the
+#: 'rpc-dispatch-latency' rule must never page on a healthy long run.
+#: (They stay covered by ``gol_rpc_server_request_seconds`` and, for
+#: sessions, ``gol_session_turn_seconds``/``_admit_wait_seconds``.)
+BLOCKING_METHODS = frozenset({Methods.BROKER_RUN, Methods.SESSION_RUN})
+
+
 @dataclasses.dataclass
 class Request:
     """Mirror of stubs.Request (stubs/stubs.go:20-29)."""
@@ -102,6 +112,13 @@ class Request:
     # version-skewed pickle without the field, via getattr) = untagged /
     # the classic broker-global Retrieve.
     session_id: int = 0
+    # extension: incremental metric-timeline windows (obs/timeline.py).
+    # A Status caller echoes the last timeline ``seq`` it received and
+    # the server ships only newer samples — history without re-shipping
+    # the whole ring each poll. Servers read it via getattr: a
+    # version-skewed older client's pickle lacks it and 0 means "the
+    # full ring", exactly like the other extension defaults.
+    timeline_since: int = 0
 
 
 @dataclasses.dataclass

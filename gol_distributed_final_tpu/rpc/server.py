@@ -19,7 +19,12 @@ from ..obs import metrics as _metrics
 from ..obs import tracing as _tracing
 from . import faults as _faults
 from . import integrity as _integrity
-from .protocol import Response, recv_frame_sized, send_frame
+from .protocol import (
+    BLOCKING_METHODS,
+    Response,
+    recv_frame_sized,
+    send_frame,
+)
 
 # structured error replies carry the remote traceback's TAIL (the raise
 # site), truncated so a deep recursion can't balloon an error frame
@@ -141,7 +146,24 @@ class RpcServer:
                     # a raise lands as a structured error reply like any
                     # handler exception — defined behavior, not a hang
                     _faults.fault_point("rpc.dispatch")
-                    result = fn(request)
+                    # handler time ONLY (fn itself, success or raise) —
+                    # the serving-latency histogram the SLO rulebook
+                    # evaluates; REQUEST_SECONDS below keeps covering the
+                    # whole dispatch including the reply write. Verbs that
+                    # BLOCK by contract (protocol.BLOCKING_METHODS: their
+                    # handler wall is the run length) are excluded, or a
+                    # healthy long run would page 'rpc-dispatch-latency'.
+                    meter_fn = (
+                        _metrics.enabled() and verb not in BLOCKING_METHODS
+                    )
+                    t_fn = time.monotonic() if meter_fn else 0.0
+                    try:
+                        result = fn(request)
+                    finally:
+                        if t_fn and _metrics.enabled():
+                            _ins.RPC_DISPATCH_SECONDS.labels(verb).observe(
+                                time.monotonic() - t_fn
+                            )
                     if span is not None and isinstance(result, Response):
                         # reply-side context: lets the client link its
                         # round-trip span to this handler span

@@ -270,10 +270,16 @@ class WorkerService:
 
     def status(self, req: Request) -> Response:
         """Read-only registry snapshot (obs/) — the broker verb's worker
-        twin. Ignores every request field: version-skew-safe."""
+        twin. The only request field read is the optional
+        ``timeline_since`` seq (getattr + isinstance: version-skew-safe;
+        absent means the full timeline ring)."""
         from ..obs.report import status_payload
 
-        return Response(status=status_payload(role="worker"))
+        since = getattr(req, "timeline_since", 0)
+        return Response(status=status_payload(
+            role="worker",
+            timeline_since=since if isinstance(since, int) else 0,
+        ))
 
     def _shutdown(self):
         self._server.stop()
@@ -306,6 +312,14 @@ def main(argv=None) -> None:
              "read-only GameOfLifeOperations.Status verb",
     )
     parser.add_argument(
+        "-timeline", nargs="?", const=1.0, default=None, type=float,
+        metavar="SECS",
+        help="enable the server-side metric timeline + SLO rulebook "
+             "(obs/timeline.py, obs/slo.py) at this sampling cadence "
+             "(default 1 s); incremental windows + alert states ship in "
+             "Status replies; implies -metrics",
+    )
+    parser.add_argument(
         "-trace", action="store_true", default=False,
         help="enable the span tracer + flight recorder (obs/): Update "
              "dispatch spans join the broker's trace via Request.trace_ctx "
@@ -324,6 +338,12 @@ def main(argv=None) -> None:
         from ..obs import metrics
 
         metrics.enable()
+    if args.timeline is not None:
+        if args.timeline <= 0:
+            parser.error(f"-timeline SECS must be > 0, got {args.timeline}")
+        from ..obs import timeline
+
+        timeline.enable(period=args.timeline)  # implies metrics.enable()
     server, service = serve(args.port, args.host)
     if args.trace:
         # after serve(): the BOUND port (not a requested 0) distinguishes

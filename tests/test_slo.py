@@ -1,0 +1,825 @@
+"""Serving-SLO layer tests (obs/timeline.py, obs/slo.py, obs/doctor.py):
+timeline ring wraparound + counter-reset detection + quantile math vs a
+numpy oracle, multi-window burn-rate rule firing on synthetic series,
+skew-safe Status round-trips of incremental timeline windows, the watch
+ALERTS panel pure render, doctor correlation on a canned multi-process
+fixture, and one live broker+worker poll with ``-timeline`` on.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from gol_distributed_final_tpu.obs import metrics as obs_metrics
+from gol_distributed_final_tpu.obs import slo
+from gol_distributed_final_tpu.obs import timeline as obs_timeline
+from gol_distributed_final_tpu.obs.metrics import DEFAULT_BUCKETS, Registry
+from gol_distributed_final_tpu.obs.timeline import (
+    TimelineSampler,
+    counter_delta,
+    quantile_from_buckets,
+)
+
+from helpers import REPO_ROOT
+from test_rpc import _spawn, _wait_listening
+
+
+@pytest.fixture
+def live_metrics():
+    """Enable the process-global registry for one test, zeroed before and
+    disabled+zeroed after (the test_obs.py posture)."""
+    reg = obs_metrics.registry()
+    reg.reset()
+    obs_metrics.enable()
+    yield reg
+    obs_metrics.enable(False)
+    reg.reset()
+
+
+def _ticking_sampler(capacity=64):
+    """A sampler over a private registry with deterministic clocks:
+    returns (registry, sampler, tick) where tick() advances one second."""
+    reg = Registry()
+    tl = TimelineSampler(registry=reg, period=1.0, capacity=capacity)
+    state = {"t": 1000.0, "w": 5000.0}
+
+    def tick(n=1):
+        for _ in range(n):
+            state["t"] += 1.0
+            state["w"] += 1.0
+            tl.sample_once(now=state["t"], wall=state["w"])
+
+    return reg, tl, tick
+
+
+# -- timeline rings ----------------------------------------------------------
+
+
+def test_ring_wraparound_bounds_memory():
+    """The per-series ring holds exactly ``capacity`` samples no matter
+    how long the process runs; seqs keep increasing across the wrap."""
+    reg, tl, tick = _ticking_sampler(capacity=8)
+    c = reg.counter("x_total")
+    for _ in range(30):
+        c.inc()
+        tick()
+    ring = tl._rings("x_total")[0]
+    assert len(ring.samples) == 8
+    seqs = [s[0] for s in ring.samples]
+    assert seqs == sorted(seqs) and seqs[-1] == 30
+    # the window only reaches what the ring holds — and still answers
+    assert tl.increase("x_total", 1000.0) == 7
+
+
+def test_counter_reset_detection_no_negative_rates():
+    """A registry reset (process restart's in-process twin) folds the
+    previous total into a base: increase/rate stay >= 0, never the
+    negative garbage a raw subtraction would produce."""
+    reg, tl, tick = _ticking_sampler()
+    c = reg.counter("x_total")
+    c.inc(10)
+    tick()
+    c.inc(10)
+    tick()
+    reg.reset()  # counter back to 0
+    c.inc(3)
+    tick()
+    assert tl.reset_count("x_total") == 1
+    inc = tl.increase("x_total", 10.0)
+    assert inc is not None and inc >= 0
+    assert inc == 13  # 10 after the first sample + 3 after the reset
+    rate = tl.rate("x_total", 10.0)
+    assert rate is not None and rate >= 0
+
+
+def test_histogram_reset_detection():
+    """Histogram count/sum/buckets fold across resets element-wise, so
+    windowed quantiles never see negative bucket deltas."""
+    reg, tl, tick = _ticking_sampler()
+    h = reg.histogram("lat_seconds")
+    h.observe(0.01)
+    tick()
+    reg.reset()
+    for _ in range(5):
+        h.observe(0.04)
+    tick()
+    assert tl.reset_count("lat_seconds") == 1
+    # the pre-reset observation was already committed in the first
+    # sample; the window increase is the 5 post-reset observations
+    assert tl.increase("lat_seconds", 10.0) == 5
+    q = tl.quantile("lat_seconds", 0.5, 10.0)
+    assert q is not None and 0.025 < q <= 0.05
+
+
+def test_counter_delta_client_side():
+    """The shared reset logic obs/watch.py rides: monotone polls
+    subtract, a backwards poll (restart) yields the new total."""
+    assert counter_delta(100, 150) == 50
+    assert counter_delta(100, 100) == 0
+    assert counter_delta(100, 7) == 7  # restarted server, never -93
+
+
+def test_quantile_math_vs_numpy_oracle():
+    """Windowed bucket-interpolated quantiles agree with numpy's within
+    one bucket's resolution (the best any fixed-edge histogram can do)."""
+    rng = np.random.default_rng(42)
+    values = rng.lognormal(mean=-6.0, sigma=1.2, size=4000)
+    reg, tl, tick = _ticking_sampler()
+    h = reg.histogram("lat_seconds")
+    tick()  # a pre-observation sample so the window has a baseline
+    for v in values:
+        h.observe(float(v))
+    tick()
+    edges = (0.0,) + DEFAULT_BUCKETS
+    for q in (0.5, 0.9, 0.99):
+        est = tl.quantile("lat_seconds", q, 10.0)
+        truth = float(np.quantile(values, q))
+        # the estimate must land in the same bucket as the oracle
+        i = int(np.searchsorted(DEFAULT_BUCKETS, truth))
+        lo = edges[i]
+        hi = DEFAULT_BUCKETS[i] if i < len(DEFAULT_BUCKETS) else edges[-1]
+        assert lo <= est <= hi, (q, est, truth, lo, hi)
+
+
+def test_quantile_edge_cases():
+    assert quantile_from_buckets((0.1, 1.0), [0, 0, 0], 0.99) is None
+    # everything in the overflow slot clamps to the last finite edge
+    assert quantile_from_buckets((0.1, 1.0), [0, 0, 5], 0.5) == 1.0
+    # single bucket interpolates within [lower edge, its edge]
+    est = quantile_from_buckets((0.1, 1.0), [4, 0, 0], 0.5)
+    assert 0.0 < est <= 0.1
+
+
+def test_incremental_window_and_summary():
+    """window(since=seq) ships only newer samples; the summary carries
+    server-computed rates and p99s; the whole payload is plain JSON."""
+    reg, tl, tick = _ticking_sampler()
+    c = reg.counter("x_total")
+    h = reg.histogram("lat_seconds")
+    for _ in range(5):
+        c.inc(7)
+        h.observe(0.02)
+        tick()
+    w = tl.window(since=0)
+    assert w["seq"] == 5 and len(w["series"]) == 2
+    json.dumps(w)  # JSON-able end to end
+    counter_series = next(
+        s for s in w["series"] if s["name"] == "x_total"
+    )
+    assert len(counter_series["samples"]) == 5
+    summary = w["summary"]
+    assert summary["x_total"]["rate_per_s"] == pytest.approx(7.0)
+    assert summary["lat_seconds"]["p99_s"] is not None
+    assert summary["lat_seconds"]["rate_per_s"] == pytest.approx(1.0)
+    # incremental: nothing new since seq -> empty series, same summary
+    w2 = tl.window(since=w["seq"])
+    assert w2["series"] == []
+    c.inc()
+    tick()
+    # one new tick: EVERY series gains exactly one sample past the seq
+    w3 = tl.window(since=w["seq"])
+    assert [len(s["samples"]) for s in w3["series"]] == [1, 1]
+
+
+def test_chrome_counter_samples():
+    """Counters export as per-second rate tracks, gauges as values —
+    and they fold into the Chrome trace as ph:"C" events on a dedicated
+    track."""
+    from gol_distributed_final_tpu.obs.tracing import to_chrome_trace
+
+    reg, tl, tick = _ticking_sampler()
+    c = reg.counter("x_total")
+    g = reg.gauge("depth")
+    for i in range(3):
+        c.inc(5)
+        g.set(i + 1)
+        tick()
+    samples = tl.chrome_counter_samples()
+    names = {s["name"] for s in samples}
+    assert "x_total /s" in names and "depth" in names
+    rates = [s["value"] for s in samples if s["name"] == "x_total /s"]
+    assert all(r == pytest.approx(5.0) for r in rates)
+    trace = to_chrome_trace([], samples)
+    cs = [e for e in trace["traceEvents"] if e["ph"] == "C"]
+    assert len(cs) == len(samples)
+    meta = [e for e in trace["traceEvents"] if e["ph"] == "M"]
+    assert any(e["args"]["name"] == "metrics timeline" for e in meta)
+
+
+# -- SLO rules ---------------------------------------------------------------
+
+
+def test_burn_rate_rule_needs_both_windows():
+    """The SRE two-window recipe: a fresh error burst trips the fast
+    window immediately but the rule only fires once the SLOW window
+    burns too; recovery clears it."""
+    reg, tl, tick = _ticking_sampler()
+    reqs = reg.counter("reqs_total")
+    errs = reg.counter("errs_total")
+    rule = slo.BurnRateRule(
+        "r", "page", "errs_total", "reqs_total",
+        objective=0.99, factor=10.0, fast_s=3.0, slow_s=12.0,
+    )
+    # 12 clean seconds: the slow window is full of 0-ratio history
+    for _ in range(12):
+        reqs.inc(10)
+        tick()
+    assert rule.evaluate(tl)[0] is False
+    # errors start: the fast window burns at once, the slow one lags
+    fired_at = None
+    for i in range(12):
+        reqs.inc(10)
+        errs.inc(5)
+        tick()
+        firing, value, detail = rule.evaluate(tl)
+        if firing and fired_at is None:
+            fired_at = i
+    assert fired_at is not None and fired_at >= 1, (
+        "must not fire on the first bad tick (slow window still clean)"
+    )
+    # recovery: clean traffic ages the errors out of both windows
+    for _ in range(14):
+        reqs.inc(10)
+        tick()
+    assert rule.evaluate(tl)[0] is False
+
+
+def test_increase_rule_fires_and_ages_out():
+    reg, tl, tick = _ticking_sampler()
+    lost = reg.counter("gol_worker_lost_total")
+    rule = slo.IncreaseRule("worker-lost", "page",
+                            "gol_worker_lost_total", window_s=5.0)
+    tick(2)
+    assert rule.evaluate(tl)[0] is False
+    lost.inc()
+    tick()  # the loss lands on the very next tick — within one window
+    assert rule.evaluate(tl)[0] is True
+    tick(8)  # ages out
+    assert rule.evaluate(tl)[0] is False
+
+
+def test_gauge_ratio_and_growth_rules():
+    reg, tl, tick = _ticking_sampler()
+    use = reg.gauge("hbm_use", labelnames=("device",))
+    cap = reg.gauge("hbm_cap", labelnames=("device",))
+    dl = reg.gauge("deadline_s")
+    ratio = slo.GaugeRatioRule("hbm", "page", "hbm_use", "hbm_cap",
+                               max_ratio=0.9)
+    growth = slo.GrowthRule("dl", "warn", "deadline_s", factor=3.0,
+                            window_s=10.0, floor=1.0)
+    use.labels("0").set(50)
+    cap.labels("0").set(100)
+    dl.set(2.0)
+    tick(2)
+    assert ratio.evaluate(tl)[0] is False
+    assert growth.evaluate(tl)[0] is False
+    use.labels("0").set(95)
+    dl.set(7.0)  # 3.5x the window-ago value
+    tick()
+    firing, value, _ = ratio.evaluate(tl)
+    assert firing and value == pytest.approx(0.95)
+    firing, g, _ = growth.evaluate(tl)
+    assert firing and g == pytest.approx(3.5)
+
+
+def test_rulebook_transitions_meter_and_flight(live_metrics):
+    """A firing transition increments gol_slo_alerts_total{rule,severity}
+    exactly once per fire, lands an slo.fire flight event, and the
+    snapshot is JSON-able with firing rules first."""
+    from gol_distributed_final_tpu.obs import flight as obs_flight
+
+    reg, tl, tick = _ticking_sampler()
+    lost = reg.counter("gol_worker_lost_total")
+    rb = slo.RuleBook([
+        slo.IncreaseRule("worker-lost", "page",
+                         "gol_worker_lost_total", window_s=4.0),
+        slo.IncreaseRule("never", "warn", "absent_total", window_s=4.0),
+    ])
+    obs_flight.recorder().reset()
+    obs_flight.enable()
+    try:
+        tick(2)
+        rb.evaluate(tl, now=1.0, wall=2.0)
+        lost.inc()
+        tick()
+        transitions = rb.evaluate(tl, now=2.0, wall=3.0)
+        assert transitions == [{"rule": "worker-lost", "event": "fire"}]
+        # still firing: no second increment
+        tick()
+        rb.evaluate(tl, now=3.0, wall=4.0)
+        snap = live_metrics.snapshot()
+        fam = next(
+            f for f in snap["families"]
+            if f["name"] == "gol_slo_alerts_total"
+        )
+        assert fam["series"] == [
+            {"labels": ["worker-lost", "page"], "value": 1.0}
+        ]
+        events = obs_flight.recorder().snapshot()
+        assert any(
+            e["kind"] == "slo.fire" and e["name"] == "worker-lost"
+            for e in events
+        )
+        states = rb.snapshot()
+        json.dumps(states)
+        assert states[0]["rule"] == "worker-lost"
+        assert states[0]["state"] == "firing"
+        assert [a["rule"] for a in rb.active()] == ["worker-lost"]
+        # ages out -> clears, flight records the clear
+        tick(8)
+        transitions = rb.evaluate(tl, now=4.0, wall=5.0)
+        assert transitions == [{"rule": "worker-lost", "event": "clear"}]
+        assert rb.active() == []
+    finally:
+        obs_flight.enable(False)
+        obs_flight.recorder().reset()
+
+
+def test_blocking_verbs_excluded_from_dispatch_histogram(live_metrics):
+    """Run/SessionRun park for the whole game by contract: their handler
+    wall must never feed the dispatch-latency SLO histogram (a healthy
+    hour-long run is not a latency violation), while quick verbs must."""
+    from gol_distributed_final_tpu.rpc.broker import serve
+    from gol_distributed_final_tpu.rpc.client import RpcClient
+    from gol_distributed_final_tpu.rpc.protocol import Methods, Request
+
+    server, _service = serve(port=0)
+    client = RpcClient(f"127.0.0.1:{server.port}")
+    try:
+        board = np.zeros((8, 8), np.uint8)
+        client.call(
+            Methods.BROKER_RUN,
+            Request(world=board, turns=2, image_width=8, image_height=8,
+                    threads=1),
+            timeout=60.0,
+        )
+        client.call(Methods.STATUS, Request())
+        snap = live_metrics.snapshot()
+        fam = next(
+            f for f in snap["families"]
+            if f["name"] == "gol_rpc_dispatch_seconds"
+        )
+        verbs = {s["labels"][0] for s in fam["series"]}
+        assert Methods.STATUS in verbs
+        assert Methods.BROKER_RUN not in verbs
+        # the blocking verb stays covered by the full-dispatch histogram
+        fam = next(
+            f for f in snap["families"]
+            if f["name"] == "gol_rpc_server_request_seconds"
+        )
+        assert Methods.BROKER_RUN in {s["labels"][0] for s in fam["series"]}
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_enable_capacity_covers_rule_horizon():
+    """enable() must size the rings to span the slow SLO windows at ANY
+    cadence — a 0.2 s timeline with the default 360-sample ring would
+    silently shrink the 120 s slow window to 72 s."""
+    s = obs_timeline.enable(period=0.2, start_thread=False)
+    try:
+        assert s.capacity * 0.2 >= obs_timeline.RULE_HORIZON_S
+    finally:
+        obs_timeline.disable()
+    s = obs_timeline.enable(period=1.0, start_thread=False)
+    try:
+        assert s.capacity == obs_timeline.DEFAULT_CAPACITY
+    finally:
+        obs_timeline.disable()
+    obs_metrics.enable(False)  # enable() implied it; leave tests clean
+    obs_metrics.registry().reset()
+
+
+def test_default_rules_match_contract():
+    rules = slo.default_rules()
+    assert tuple(r.name for r in rules) == slo.DEFAULT_RULE_NAMES
+    with pytest.raises(ValueError):
+        slo.RuleBook([slo.IncreaseRule("a", "page", "x_total")] * 2)
+    with pytest.raises(ValueError):
+        slo.IncreaseRule("a", "sev-nope", "x_total")
+
+
+# -- Status round-trip + skew -----------------------------------------------
+
+
+def test_status_payload_timeline_roundtrip(live_metrics):
+    """status_payload ships the incremental window + alert states while
+    the global sampler is on, nothing when off — and the payload stays
+    plain JSON (the restricted-unpickler contract)."""
+    from gol_distributed_final_tpu.obs.report import status_payload
+
+    assert "timeline" not in status_payload(role="t")
+    tl = obs_timeline.enable(period=60.0, start_thread=False)
+    try:
+        obs_metrics.registry().counter("gol_engine_turns_total").inc(5)
+        tl.sample_once()
+        tl.sample_once()
+        payload = status_payload(role="t", timeline_since=0)
+        assert payload["timeline"]["seq"] == 2
+        assert payload["timeline"]["series"]
+        assert isinstance(payload["alerts"], list)
+        json.dumps(payload["timeline"])
+        json.dumps(payload["alerts"])
+        # incremental: a poller that echoes seq gets only newer samples
+        again = status_payload(role="t", timeline_since=2)
+        assert again["timeline"]["series"] == []
+    finally:
+        obs_timeline.disable()
+    assert "timeline" not in status_payload(role="t")
+
+
+def test_old_client_status_request_gets_full_window(live_metrics):
+    """A version-skewed client whose Request pickle predates
+    ``timeline_since`` must get the full ring (the getattr default),
+    never an AttributeError reply — and a hostile non-int value must
+    degrade the same way."""
+    from gol_distributed_final_tpu.rpc.broker import serve
+    from gol_distributed_final_tpu.rpc.client import RpcClient
+    from gol_distributed_final_tpu.rpc.protocol import Methods, Request
+
+    tl = obs_timeline.enable(period=60.0, start_thread=False)
+    server, _service = serve(port=0)
+    client = RpcClient(f"127.0.0.1:{server.port}")
+    try:
+        obs_metrics.registry().counter("gol_engine_turns_total").inc()
+        tl.sample_once()
+        old = Request()
+        del old.__dict__["timeline_since"]
+        res = client.call(Methods.STATUS, old)
+        assert res.status["timeline"]["seq"] == 1
+        assert res.status["timeline"]["series"]
+        bad = Request()
+        bad.timeline_since = "not-a-seq"
+        res = client.call(Methods.STATUS, bad)
+        assert res.status["timeline"]["seq"] == 1  # treated as 0, not a crash
+    finally:
+        client.close()
+        server.stop()
+        obs_timeline.disable()
+
+
+# -- watch ALERTS panel ------------------------------------------------------
+
+
+def test_watch_alerts_panel_pure_render():
+    from gol_distributed_final_tpu.obs.watch import render_status
+
+    payload = {
+        "role": "broker", "pid": 1, "metrics_enabled": True,
+        "metrics": {"families": []},
+        "alerts": [
+            {"rule": "worker-lost", "severity": "page", "state": "firing",
+             "since_unix": 1.0, "value": 1,
+             "detail": "gol_worker_lost_total +1 over 60s (> 0)"},
+            {"rule": "hbm-headroom", "severity": "page", "state": "ok",
+             "since_unix": None, "value": None, "detail": ""},
+        ],
+    }
+    out = render_status("broker :1", payload)
+    assert "ALERTS — 1 FIRING" in out
+    assert "PAGE worker-lost" in out.replace("** ", "")
+    assert "gol_worker_lost_total +1" in out
+    # all-ok rulebook renders the quiet line; no alerts field renders none
+    payload["alerts"] = [dict(payload["alerts"][1])]
+    out = render_status("broker :1", payload)
+    assert "none firing" in out
+    del payload["alerts"]
+    assert "ALERTS" not in render_status("broker :1", payload)
+
+
+def test_watch_timeline_panel_and_reset_safe_rate():
+    """The TIMELINE panel renders server-computed rates; the client-side
+    turns rate survives a counter reset (the satellite fix)."""
+    from gol_distributed_final_tpu.obs.watch import Watcher, render_status
+
+    payload = {
+        "role": "broker", "pid": 1, "metrics_enabled": True,
+        "metrics": {"families": []},
+        "timeline": {
+            "seq": 9, "period_s": 1.0, "summary_window_s": 60,
+            "series": [],
+            "summary": {
+                "gol_engine_turns_total": {"rate_per_s": 1234.5,
+                                           "increase": 100},
+                "gol_session_turn_seconds": {
+                    "rate_per_s": 10.0, "count": 10, "mean_s": 0.01,
+                    "p50_s": 0.01, "p99_s": 0.02,
+                },
+            },
+        },
+    }
+    out = render_status("broker :1", payload)
+    assert "TIMELINE (server-side" in out
+    assert "1,234.5/s" in out and "p99" in out
+
+    watcher = Watcher(":1", [], timeout=1.0)
+
+    def poll(turns):
+        return watcher._turns_rate(":1", {
+            "metrics": {"families": [{
+                "name": "gol_engine_turns_total", "type": "counter",
+                "labelnames": [],
+                "series": [{"labels": [], "value": turns}],
+            }]},
+        })
+
+    assert poll(100) is None  # first poll: no rate yet
+    rate = poll(150)
+    assert rate is not None and rate >= 0
+    rate = poll(30)  # server restarted: 30 < 150
+    assert rate is not None and rate >= 0  # never negative
+
+
+# -- doctor ------------------------------------------------------------------
+
+
+def _canned_statuses():
+    """A multi-process fixture: a broker with a lost, thrice-flapped
+    worker + firing alert + integrity failure, one healthy worker, one
+    unreachable worker."""
+    lost_events = [
+        {"kind": "worker.lost", "name": "127.0.0.1:8041",
+         "t_unix": 10.0, "t_mono": 1.0, "pid": 1, "tid": 1,
+         "args": {"reason": "scatter failed"}, "seq": i}
+        for i in range(3)
+    ]
+    broker = {
+        "role": "broker", "pid": 11, "metrics_enabled": True,
+        "workers": [
+            {"address": "127.0.0.1:8040", "state": "connected"},
+            {"address": "127.0.0.1:8041", "state": "lost",
+             "retry_in_s": 12.5},
+        ],
+        "flight": lost_events + [
+            {"kind": "integrity.fail", "name": "127.0.0.1:8041",
+             "t_unix": 11.0, "t_mono": 2.0, "pid": 11, "tid": 1,
+             "args": {"check": "attest"}, "seq": 9},
+        ],
+        "alerts": [
+            {"rule": "worker-lost", "severity": "page", "state": "firing",
+             "since_unix": 5.0, "value": 3.0,
+             "detail": "gol_worker_lost_total +3 over 60s (> 0)"},
+            {"rule": "hbm-headroom", "severity": "page", "state": "ok",
+             "since_unix": None, "value": None, "detail": ""},
+        ],
+        "metrics": {"families": [
+            {"name": "gol_worker_lost_total", "type": "counter",
+             "labelnames": [],
+             "series": [{"labels": [], "value": 3.0}]},
+            {"name": "gol_worker_readmitted_total", "type": "counter",
+             "labelnames": [],
+             "series": [{"labels": [], "value": 2.0}]},
+            {"name": "gol_strip_resync_total", "type": "counter",
+             "labelnames": [],
+             "series": [{"labels": [], "value": 7.0}]},
+            {"name": "gol_integrity_failures_total", "type": "counter",
+             "labelnames": ["kind"],
+             "series": [{"labels": ["attest"], "value": 1.0}]},
+            {"name": "gol_engine_turns_total", "type": "counter",
+             "labelnames": [],
+             "series": [{"labels": [], "value": 500.0}]},
+            {"name": "gol_wire_bytes_total", "type": "counter",
+             "labelnames": ["verb", "direction"],
+             "series": [{
+                 "labels": ["GameOfLifeOperations.StripStep", "sent"],
+                 "value": 6_000_000.0,
+             }]},
+        ]},
+    }
+    healthy_worker = {
+        "role": "worker", "pid": 12, "metrics_enabled": True,
+        "metrics": {"families": []},
+    }
+    return {
+        "broker 127.0.0.1:9000": broker,
+        "worker 127.0.0.1:8040": healthy_worker,
+        "worker 127.0.0.1:8041": {"error": "poll failed: refused"},
+    }
+
+
+def test_doctor_correlation_on_canned_fixture(tmp_path):
+    from gol_distributed_final_tpu.obs import doctor
+
+    statuses = _canned_statuses()
+    findings = doctor.diagnose(statuses)
+    assert findings and findings[0]["rank"] == 1
+    # the top-ranked finding names the flapping worker as the suspect
+    top = findings[0]
+    assert top["severity"] == "page"
+    assert "127.0.0.1:8041" in top["suspects"]
+    assert "flapping" in top["title"] or "quarantined" in top["title"]
+    titles = " | ".join(f["title"] for f in findings)
+    assert "integrity" in titles  # the caught corruption is a finding
+    assert "worker-lost" in titles  # the firing SLO rule is a finding
+    # evidence correlates the machinery: flight count + resync + probe
+    assert any("3 loss event" in e for e in top["evidence"])
+    assert any("resync" in e for e in top["evidence"])
+    # pure render + artifact
+    text = doctor.render(findings, statuses)
+    assert "127.0.0.1:8041" in text and "#1 [PAGE]" in text
+    assert "UNREACHABLE" in text
+    path = doctor.write_report(findings, statuses, tmp_path)
+    report = json.loads(path.read_text())
+    assert report["schema"] == "gol-doctor/1"
+    assert report["findings"][0]["title"] == top["title"]
+    assert report["targets"]["broker 127.0.0.1:9000"]["firing_alerts"] == [
+        "worker-lost"
+    ]
+
+
+def test_doctor_healthy_cluster_still_renders(tmp_path):
+    """A clean bill of health is itself a finding: the diagnosis is
+    never empty (the scripts/check --doctor renderability contract)."""
+    from gol_distributed_final_tpu.obs import doctor
+
+    statuses = {
+        "broker 127.0.0.1:9000": {
+            "role": "broker", "pid": 1, "metrics_enabled": True,
+            "metrics": {"families": []},
+        },
+    }
+    findings = doctor.diagnose(statuses)
+    assert len(findings) == 1 and findings[0]["severity"] == "info"
+    assert "no anomalies" in findings[0]["title"]
+    assert doctor.render(findings, statuses).strip()
+
+
+def test_doctor_stall_heuristic():
+    from gol_distributed_final_tpu.obs import doctor
+
+    statuses = {"broker b": {
+        "role": "broker", "pid": 1, "metrics_enabled": True,
+        "metrics": {"families": [
+            {"name": "gol_engine_turns_total", "type": "counter",
+             "labelnames": [],
+             "series": [{"labels": [], "value": 900.0}]},
+        ]},
+        "timeline": {"summary": {
+            "gol_engine_turns_total": {"rate_per_s": 0.0, "increase": 0},
+        }},
+        "flight": [{"kind": "span.open", "name": "broker.turn",
+                    "t_unix": 1.0, "t_mono": 1.0, "pid": 1, "tid": 1,
+                    "args": {}, "seq": 1}],
+    }}
+    findings = doctor.diagnose(statuses)
+    stall = next(f for f in findings if "stalled" in f["title"])
+    assert any("broker.turn" in e for e in stall["evidence"])
+    # the REAL wedged shape: the summary DROPS zero-increase counters,
+    # so a fully stalled engine's entry is ABSENT — that must still
+    # read as rate 0 and fire (a missing timeline entirely must not)
+    statuses["broker b"]["timeline"] = {"summary": {}}
+    findings = doctor.diagnose(statuses)
+    assert any("stalled" in f["title"] for f in findings)
+    del statuses["broker b"]["timeline"]
+    findings = doctor.diagnose(statuses)
+    assert not any("stalled" in f["title"] for f in findings)
+
+
+# -- run report --------------------------------------------------------------
+
+
+def test_run_report_embeds_timeline_and_alerts(tmp_path, live_metrics):
+    from gol_distributed_final_tpu.obs.report import write_run_report
+    from gol_distributed_final_tpu.params import Params
+
+    tl = obs_timeline.enable(period=60.0, start_thread=False)
+    try:
+        tl.sample_once(now=1.0, wall=1.0)
+        live_metrics.counter("gol_engine_turns_total").inc(50)
+        live_metrics.counter("gol_worker_lost_total").inc()
+        tl.sample_once(now=2.0, wall=2.0)
+        params = Params(turns=3, threads=1, image_width=8, image_height=8)
+        path = write_run_report(params, tmp_path)
+        report = json.loads(path.read_text())
+        assert report["timeline"]["gol_engine_turns_total"]["increase"] == 50
+        assert report["alerts_fired"] == ["worker-lost"]
+        states = {a["rule"]: a["state"] for a in report["alerts"]}
+        assert states["worker-lost"] == "firing"
+    finally:
+        obs_timeline.disable()
+
+
+# -- the lint ----------------------------------------------------------------
+
+
+def test_slo_lints_pass_on_real_readme():
+    from gol_distributed_final_tpu.obs import lint
+
+    assert lint.undocumented_slo_metrics() == []
+    assert lint.undocumented_slo_rules() == []
+    assert lint.missing_readme_sections() == []
+
+
+def test_slo_lint_catches_drift(tmp_path):
+    bad = tmp_path / "README.md"
+    bad.write_text(
+        "## SLOs & alerting\n\ngol_slo_alerts_total only\n\n## Doctor\nx\n"
+    )
+    from gol_distributed_final_tpu.obs import lint
+
+    missing = lint.undocumented_slo_metrics(bad)
+    assert "gol_session_turn_seconds" in missing
+    assert "gol_slo_alerts_total" not in missing
+    rules = lint.undocumented_slo_rules(bad)
+    assert "worker-lost" in rules
+
+
+# -- live: one broker+worker poll with -timeline on --------------------------
+
+
+def test_live_timeline_status_poll():
+    """A -timeline broker + worker cluster: one Status poll returns
+    server-computed rates and p99s for the serving histograms, the alert
+    states ride along, a second poll's echoed seq gets an INCREMENTAL
+    window, and the doctor diagnoses the live payloads."""
+    import time as _time
+
+    from gol_distributed_final_tpu.obs import doctor
+    from gol_distributed_final_tpu.obs.status import fetch_status
+    from gol_distributed_final_tpu.rpc.client import RemoteBroker
+
+    worker = _spawn(
+        "gol_distributed_final_tpu.rpc.worker",
+        "-port", "0", "-timeline", "0.2",
+    )
+    worker_port = _wait_listening(worker)
+    broker = _spawn(
+        "gol_distributed_final_tpu.rpc.broker",
+        "-port", "0", "-backend", "workers",
+        "-workers", f"127.0.0.1:{worker_port}",
+        "-timeline", "0.2",
+    )
+    broker_port = _wait_listening(broker)
+    addr = f"127.0.0.1:{broker_port}"
+    try:
+        # let the samplers tick before traffic lands: the serving
+        # histograms' series are born mid-window and diff against the
+        # implicit zero seed (a just-started server's first period is
+        # the one blind window, by design)
+        _time.sleep(0.5)
+        rb = RemoteBroker(addr)
+        from gol_distributed_final_tpu.params import Params
+
+        rng = np.random.default_rng(3)
+        board = np.where(
+            rng.random((32, 32)) < 0.3, 255, 0
+        ).astype(np.uint8)
+        rb.run(Params(turns=40, threads=2, image_width=32,
+                      image_height=32), board)
+        rb.close()
+        _time.sleep(0.6)  # a few sampler ticks past the run
+        payload = fetch_status(addr, timeout=10.0)
+        tl = payload["timeline"]
+        assert tl["series"], "timeline window must carry samples"
+        assert isinstance(payload["alerts"], list)
+        # server-computed rates + p99s for the serving histograms, no
+        # client math (the run's handler latency rides the request
+        # histogram; the blocking Run verb is EXCLUDED from the
+        # dispatch-latency SLO feed by contract)
+        run_req = tl["summary"].get(
+            "gol_rpc_server_request_seconds{method=Operations.Run}"
+        )
+        assert run_req and run_req["p99_s"] is not None, tl["summary"].keys()
+        assert (
+            "gol_rpc_dispatch_seconds{method=Operations.Run}"
+            not in tl["summary"]
+        )
+        # incremental second poll: echoing seq ships only newer ticks,
+        # and the first poll's own (quick-verb) Status dispatch has
+        # landed in the SLO histogram by now
+        seq = tl["seq"]
+        _time.sleep(0.5)
+        payload2 = fetch_status(addr, timeout=10.0, timeline_since=seq)
+        tl2 = payload2["timeline"]
+        assert tl2["seq"] > seq
+        dispatch = [
+            k for k in tl2["summary"]
+            if k.startswith("gol_rpc_dispatch_seconds")
+        ]
+        assert dispatch, tl2["summary"].keys()
+        assert all(
+            tl2["summary"][k]["p99_s"] is not None for k in dispatch
+        )
+        assert all(
+            s2[0] > seq
+            for series in tl2["series"]
+            for s2 in series["samples"]
+        )
+        # the worker's twin verb serves its own timeline
+        wpayload = fetch_status(
+            f"127.0.0.1:{worker_port}", worker=True, timeout=10.0
+        )
+        assert wpayload["timeline"]["series"]
+        # and the doctor can diagnose the live pair
+        statuses = doctor.collect(
+            addr, [f"127.0.0.1:{worker_port}"], timeout=10.0
+        )
+        findings = doctor.diagnose(statuses)
+        assert findings and doctor.render(findings, statuses).strip()
+    finally:
+        for p in (broker, worker):
+            if p.poll() is None:
+                p.kill()
+            p.wait()
