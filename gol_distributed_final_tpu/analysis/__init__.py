@@ -1,0 +1,62 @@
+"""AST-based invariant checkers — the contracts reviews kept re-enforcing.
+
+Every review-hardening pass in CHANGES.md fixed the same mechanical bug
+classes by hand: a ``Request``/``Response`` extension field read without
+``getattr`` (version-skew AttributeError on an old peer's pickle), shared
+state touched outside its lock (``deque mutated during iteration``,
+double-metered SLO transitions), Python-varying values feeding a kernel's
+STATIC turn argument (the unbounded-jit-cache hazard the session batcher
+quantises away), and broad ``except: pass`` blocks that swallow evidence.
+This package turns those informal contracts into machine-checked
+invariants: a dependency-free ``ast`` framework (``core.py``) plus one
+checker module per bug class, self-hosted over the whole package by
+``scripts/check`` (the analyzer must exit clean on every commit).
+
+The README "Static analysis" section is the operator contract: checker
+ids, the invariant each enforces, and the suppression syntax
+(``# gol: allow(<check>): <justification>`` — the justification is
+mandatory and machine-enforced, so the allow-list stays auditable).
+
+Layout:
+
+* ``core.py``    — Finding/Checker framework, file walker, suppressions,
+  the runner and its exit-code contract
+* ``skew.py``    — ``skew-safety``: getattr/.get discipline on wire objects
+* ``locks.py``   — ``lock-discipline``: ``_GUARDED_BY`` field/lock contracts
+* ``jit.py``     — ``jit-cache``: quantised static kernel args, pure kernels
+* ``hygiene.py`` — ``hygiene``: daemonised/joined threads, no silent excepts
+* ``lints.py``   — the obs/lint.py README name-drift lints, re-seated as
+  repo-level checkers (one runner, one finding format, one suppression
+  syntax)
+* ``__main__.py``— the CLI: ``python -m gol_distributed_final_tpu.analysis``
+"""
+
+from __future__ import annotations
+
+from .core import Checker, Finding, Report, run  # noqa: F401
+
+
+def ast_checkers():
+    """The per-file AST checkers, stable order."""
+    from .hygiene import HygieneChecker
+    from .jit import JitCacheChecker
+    from .locks import LockDisciplineChecker
+    from .skew import SkewSafetyChecker
+
+    return [
+        SkewSafetyChecker(),
+        LockDisciplineChecker(),
+        JitCacheChecker(),
+        HygieneChecker(),
+    ]
+
+
+def repo_checkers():
+    """The repo-level checkers (README name-drift lints)."""
+    from .lints import readme_checkers
+
+    return readme_checkers()
+
+
+def all_checkers():
+    return ast_checkers() + repo_checkers()

@@ -1,0 +1,209 @@
+"""``hygiene`` — daemonised/joined threads, no silently-swallowed excepts.
+
+Two resource-lifecycle contracts:
+
+1. **Threads.** A ``threading.Thread``/``Timer`` must either be created
+   ``daemon=True`` (it may never outlive the process) or be provably
+   joined: bound to a name on which ``.join(`` is called somewhere in
+   the same file. An un-daemonised, un-joined thread wedges interpreter
+   shutdown — the broker/worker processes are long-lived servers where
+   one leaked thread turns SIGTERM into SIGKILL.
+
+2. **Excepts.** A broad handler (bare ``except:``, ``except Exception``,
+   ``except BaseException``) whose body performs NO call, NO raise and
+   NO return swallows the failure without leaving evidence — no log
+   line, no flight-recorder event, no propagation. The chaos/integrity
+   layers exist precisely because silent failure is the worst failure
+   mode; a handler that narrows the type, logs, flight-records,
+   re-raises, or returns a sentinel all pass.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from .core import Checker, Finding
+
+_THREAD_FACTORIES = frozenset({"Thread", "Timer"})
+_BROAD = frozenset({"Exception", "BaseException"})
+
+
+def _is_thread_call(node: ast.Call) -> bool:
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return (
+            func.attr in _THREAD_FACTORIES
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "threading"
+        )
+    return isinstance(func, ast.Name) and func.id in _THREAD_FACTORIES
+
+
+def _target_name(target) -> str:
+    if isinstance(target, ast.Name):
+        return target.id
+    if (
+        isinstance(target, ast.Attribute)
+        and isinstance(target.value, ast.Name)
+        and target.value.id == "self"
+    ):
+        return f"self.{target.attr}"
+    return ""
+
+
+def _broad_type(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in _BROAD
+    if isinstance(t, ast.Tuple):
+        return any(
+            isinstance(e, ast.Name) and e.id in _BROAD for e in t.elts
+        )
+    return False
+
+
+class HygieneChecker(Checker):
+    id = "hygiene"
+    description = (
+        "threads are daemon=True or joined in-file; broad except "
+        "handlers log/flight-record/raise/return instead of silently "
+        "swallowing"
+    )
+    bug_class = (
+        "leaked threads wedging process shutdown; failures vanishing "
+        "with no log, flight event, or propagation"
+    )
+
+    def check_file(self, tree, source, relpath) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        self._check_threads(tree, relpath, findings)
+        self._check_excepts(tree, relpath, findings)
+        return findings
+
+    # -- threads -------------------------------------------------------------
+
+    def _check_threads(self, tree, relpath, findings) -> None:
+        parents = {
+            child: parent
+            for parent in ast.walk(tree)
+            for child in ast.iter_child_nodes(parent)
+        }
+        bound: dict = {}  # id(call node) -> bound name
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                value = getattr(node, "value", None)
+                if isinstance(value, ast.Call) and _is_thread_call(value):
+                    targets = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for t in targets:
+                        name = _target_name(t)
+                        if name:
+                            bound[id(value)] = name
+
+        def enclosing(node, kinds):
+            cur = parents.get(node)
+            while cur is not None and not isinstance(cur, kinds):
+                cur = parents.get(cur)
+            return cur if cur is not None else tree
+
+        joins_cache: dict = {}
+
+        def joins_in(scope) -> Set[str]:
+            cached = joins_cache.get(id(scope))
+            if cached is None:
+                cached = joins_cache[id(scope)] = {
+                    name
+                    for sub in ast.walk(scope)
+                    if isinstance(sub, ast.Attribute) and sub.attr == "join"
+                    for name in (_target_name(sub.value),)
+                    if name
+                }
+            return cached
+
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and _is_thread_call(node)):
+                continue
+            daemon = next(
+                (kw for kw in node.keywords if kw.arg == "daemon"), None
+            )
+            if daemon is not None:
+                if (
+                    isinstance(daemon.value, ast.Constant)
+                    and daemon.value.value is False
+                ):
+                    pass  # explicit daemon=False: fall through to join proof
+                else:
+                    continue
+            name = bound.get(id(node))
+            if name:
+                # the join must live in the scope that OWNS the binding:
+                # the enclosing class for self.X (created in one method,
+                # joined in another), the enclosing function for locals —
+                # a same-named '_thread' joined in a DIFFERENT class is
+                # no proof for this one
+                scope = enclosing(
+                    node,
+                    ast.ClassDef
+                    if name.startswith("self.")
+                    else (ast.FunctionDef, ast.AsyncFunctionDef),
+                )
+                if name in joins_in(scope):
+                    continue
+            factory = _func_name(node)
+            findings.append(Finding(
+                self.id, relpath, node.lineno,
+                f"{factory} created without daemon=True and never joined "
+                f"in its owning scope — a leaked non-daemon thread wedges "
+                f"process shutdown",
+            ))
+
+    # -- excepts -------------------------------------------------------------
+
+    def _check_excepts(self, tree, relpath, findings) -> None:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _broad_type(node):
+                continue
+            # handled = the failure leaves evidence or control flow:
+            # a call (log/flight-record/metric), a raise, a return — or
+            # the bound exception VALUE is read (captured into state the
+            # caller inspects: the checkpoint agreement-vote pattern)
+            handled = any(
+                isinstance(sub, (ast.Call, ast.Raise, ast.Return))
+                or (
+                    node.name is not None
+                    and isinstance(sub, ast.Name)
+                    and sub.id == node.name
+                    and isinstance(sub.ctx, ast.Load)
+                )
+                for stmt in node.body
+                for sub in ast.walk(stmt)
+            )
+            if not handled:
+                what = (
+                    "bare except:" if node.type is None
+                    else "broad except"
+                )
+                findings.append(Finding(
+                    self.id, relpath, node.lineno,
+                    f"{what} swallows the failure silently (no call, "
+                    f"raise, or return in the handler) — log it, "
+                    f"flight-record it, narrow the type, or justify the "
+                    f"suppression",
+                ))
+
+
+def _func_name(node: ast.Call) -> str:
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return f"threading.{func.attr}"
+    if isinstance(func, ast.Name):
+        return func.id
+    return "Thread"

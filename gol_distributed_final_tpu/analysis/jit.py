@@ -1,0 +1,258 @@
+"""``jit-cache`` — bounded compile caches and host-free kernel bodies.
+
+Two hazards from the compiled-kernel layer (``ops/``):
+
+1. **Un-quantised static args.** The kernel family's entry points
+   (``step_n`` / ``bit_step_n`` and their ``_batch`` forms) take the
+   turn count as a STATIC argument — every distinct Python value
+   compiles a fresh program. Feeding them a raw runtime-derived value
+   (``min(remaining)``, a subtraction of counters) builds an unbounded
+   jit cache in a long-lived process, each entry a driver-thread compile
+   stall — the exact hazard the session batcher fixed by power-of-two
+   quantisation (``k = 1 << (k.bit_length() - 1)``). The checker traces
+   the turn argument through the enclosing function's assignments: a
+   value is accepted if it is a constant, an unassigned parameter, or
+   passes through a recognised quantiser (``.bit_length()``-based
+   power-of-two math, or a function named ``*quant*``/``*pow2*``);
+   it is flagged when its derivation contains ``min``/``max`` or
+   arithmetic over runtime values with no quantiser in the chain.
+
+2. **Host calls inside compiled bodies.** ``time.*``, ``random.*``,
+   ``.item()``, ``.block_until_ready()`` and ``device_get`` inside a
+   jitted function or a pallas kernel body (a ``@jit``-decorated def, or
+   a def whose name contains ``kernel``) either trace once and freeze a
+   stale value, or force a host sync in the middle of the device
+   program. Both are silent performance/correctness bugs.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Tuple
+
+from .core import Checker, Finding
+
+#: compiled-kernel entry points -> positional index of the static turn
+#: argument (counted over the call's OWN argument list; ``plane.step_n(
+#: state, n)`` and ``stencil.step_n(board, n, ...)`` both put it at 1)
+ENTRY_POINTS: Dict[str, int] = {
+    "step_n": 1,
+    "bit_step_n": 1,
+    "step_n_batch": 1,
+    "bit_step_n_batch": 1,
+}
+#: keyword spellings of the same argument
+TURN_KWARGS = ("n", "turns")
+
+#: substrings that mark a call/attribute as a quantiser: a derivation
+#: that passes through one lands on a bounded key set
+QUANTISER_HINTS = ("bit_length", "quant", "pow2")
+
+_ARITH_OPS = (ast.Add, ast.Sub, ast.Mult, ast.Div, ast.FloorDiv, ast.Mod)
+_HOST_ATTRS = frozenset({"item", "block_until_ready", "device_get"})
+_HOST_MODULES = frozenset({"time", "random"})
+
+
+def _func_name(func) -> str:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return ""
+
+
+def _contains_quantiser(expr) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and any(
+            h in node.attr for h in QUANTISER_HINTS
+        ):
+            return True
+        if isinstance(node, ast.Call) and any(
+            h in _func_name(node.func) for h in QUANTISER_HINTS
+        ):
+            return True
+    return False
+
+
+class JitCacheChecker(Checker):
+    id = "jit-cache"
+    description = (
+        "static turn/shape args to ops/ kernel entry points are "
+        "quantised (constants, parameters, or power-of-two math) — and "
+        "no time/random/.item()/host-sync calls inside jitted or pallas "
+        "kernel bodies"
+    )
+    bug_class = (
+        "unbounded jit compile caches (one program per distinct runtime "
+        "value) and traced-once/host-sync bugs in kernel bodies"
+    )
+
+    def check_file(self, tree, source, relpath) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        # module scope counts as an enclosing "function" for assignments
+        self._check_scope(tree, relpath, findings)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_scope(node, relpath, findings)
+                if self._is_compiled(node):
+                    self._check_kernel_body(node, relpath, findings)
+        return findings
+
+    # -- static-arg quantisation --------------------------------------------
+
+    def _check_scope(self, scope, relpath, findings) -> None:
+        """Audit every kernel-entry call whose enclosing scope is exactly
+        ``scope`` (nested defs get their own pass)."""
+        assigns = self._assignments(scope)
+        for node in self._own_nodes(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _func_name(node.func)
+            if name not in ENTRY_POINTS:
+                continue
+            idx = ENTRY_POINTS[name]
+            turn_arg = None
+            if len(node.args) > idx:
+                turn_arg = node.args[idx]
+            else:
+                for kw in node.keywords:
+                    if kw.arg in TURN_KWARGS:
+                        turn_arg = kw.value
+                        break
+            if turn_arg is None:
+                continue
+            if self._suspicious(turn_arg, assigns, set(), 0):
+                findings.append(Finding(
+                    self.id, relpath, node.lineno,
+                    f"static turn argument to {name}() derives from an "
+                    f"un-quantised runtime value (min/max/arithmetic): "
+                    f"every distinct value compiles a fresh program — "
+                    f"quantise (e.g. 1 << (k.bit_length() - 1)) to bound "
+                    f"the jit cache",
+                ))
+
+    @staticmethod
+    def _own_nodes(scope):
+        """Descendants of ``scope`` that are not inside a nested def."""
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _assignments(self, scope) -> Dict[str, List[Tuple[int, ast.AST]]]:
+        out: Dict[str, List[Tuple[int, ast.AST]]] = {}
+        for node in self._own_nodes(scope):
+            if isinstance(node, ast.Assign):
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        out.setdefault(target.id, []).append(
+                            (node.lineno, node.value)
+                        )
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                if isinstance(node.target, ast.Name):
+                    out.setdefault(node.target.id, []).append(
+                        (node.lineno, node.value)
+                    )
+            elif isinstance(node, ast.AugAssign):
+                if isinstance(node.target, ast.Name):
+                    out.setdefault(node.target.id, []).append(
+                        (node.lineno, node)
+                    )
+        return out
+
+    def _suspicious(self, expr, assigns, seen, depth) -> bool:
+        """True when the expression's derivation contains min/max or
+        runtime arithmetic with NO quantiser anywhere in the chain.
+        Unknown shapes (parameters, attributes, globals) are trusted —
+        the checker flags positively-identified hazards, not everything
+        it cannot prove."""
+        if depth > 5 or _contains_quantiser(expr):
+            return False
+        if isinstance(expr, ast.Constant):
+            return False
+        if isinstance(expr, ast.Name):
+            if expr.id in seen:
+                return False
+            seen = seen | {expr.id}
+            entries = assigns.get(expr.id)
+            if not entries:
+                return False  # parameter / global: caller's contract
+            # quantised ANYWHERE in the function wins: the idiom is
+            # "derive raw, then quantise in place" (engine chunk loop,
+            # session batcher)
+            if any(_contains_quantiser(rhs) for _, rhs in entries):
+                return False
+            return any(
+                self._suspicious(rhs, assigns, seen, depth + 1)
+                for _, rhs in entries
+            )
+        if isinstance(expr, ast.AugAssign):
+            return self._suspicious(expr.value, assigns, seen, depth + 1)
+        if isinstance(expr, ast.Call):
+            if _func_name(expr.func) in ("min", "max"):
+                return True
+            # a wrapper call (int(), abs(), round(), anything unknown)
+            # doesn't launder its arguments: int(min(a, b)) is the same
+            # unbounded-key hazard as min(a, b)
+            return any(
+                self._suspicious(a, assigns, seen, depth + 1)
+                for a in expr.args
+            )
+        if isinstance(expr, ast.BinOp) and isinstance(expr.op, _ARITH_OPS):
+            operands = (expr.left, expr.right)
+            if all(isinstance(o, ast.Constant) for o in operands):
+                return False
+            return True
+        if isinstance(expr, ast.IfExp):
+            return self._suspicious(
+                expr.body, assigns, seen, depth + 1
+            ) or self._suspicious(expr.orelse, assigns, seen, depth + 1)
+        return False
+
+    # -- kernel-body purity --------------------------------------------------
+
+    @staticmethod
+    def _is_compiled(node) -> bool:
+        if "kernel" in node.name:
+            return True
+        for dec in node.decorator_list:
+            for sub in ast.walk(dec):
+                if isinstance(sub, ast.Attribute) and sub.attr == "jit":
+                    return True
+                if isinstance(sub, ast.Name) and sub.id == "jit":
+                    return True
+        return False
+
+    def _check_kernel_body(self, func, relpath, findings) -> None:
+        for node in ast.walk(func):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = node.func
+            if isinstance(callee, ast.Attribute):
+                if callee.attr in _HOST_ATTRS:
+                    findings.append(Finding(
+                        self.id, relpath, node.lineno,
+                        f".{callee.attr}() inside compiled body "
+                        f"'{func.name}': a host sync/get in a traced "
+                        f"function freezes at trace time or stalls the "
+                        f"device program",
+                    ))
+                    continue
+                root = callee
+                while isinstance(root, ast.Attribute):
+                    root = root.value
+                if (
+                    isinstance(root, ast.Name)
+                    and root.id in _HOST_MODULES
+                ):
+                    findings.append(Finding(
+                        self.id, relpath, node.lineno,
+                        f"{root.id}.{callee.attr}() inside compiled body "
+                        f"'{func.name}': evaluated ONCE at trace time, "
+                        f"then frozen into every later call",
+                    ))

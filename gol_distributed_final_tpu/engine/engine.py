@@ -382,6 +382,9 @@ class Engine:
                     if _tracing.enabled() else None
                 )
                 with _tracing.annotate("engine.chunk"):
+                    # gol: allow(jit-cache): chunk doubles by powers of
+                    # two; the min() only clips the FINAL remainder, so a
+                    # run compiles at most log2(turns)+2 distinct n values
                     new_state = active_plane.step_n(state, n)
                 if growing:
                     # accurate per-chunk timing drives the doubling below

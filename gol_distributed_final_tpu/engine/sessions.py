@@ -112,6 +112,17 @@ class SessionTable:
     """Up to ``capacity`` concurrent universes of ONE geometry/rule in a
     device-resident batch tensor (see module docstring)."""
 
+    # the batch tensor and the session lists move together under _lock:
+    # a snapshot must never pair a new turn with a stale count, and a
+    # session must be findable in exactly one list at any instant
+    # (machine-enforced: analysis/locks.py)
+    _GUARDED_BY = {
+        "_state": "_lock",
+        "_active": "_lock",
+        "_pending": "_lock",
+        "_next_sid": "_lock",
+    }
+
     def __init__(
         self,
         rule: LifeRule = CONWAY,
@@ -307,8 +318,11 @@ class SessionTable:
         for s, ev in events:
             try:
                 s.on_event(ev)
+            # gol: allow(hygiene): an observer callback must never stall
+            # the batch, and this runs per event in the serving hot loop —
+            # too hot for per-failure logging
             except Exception:
-                pass  # an observer must never stall the batch
+                pass
         # completion LAST: a waiter woken by done must find every event —
         # FinalTurnComplete included — already delivered
         if finished:

@@ -42,6 +42,9 @@ class FlightRecorder:
     surface: one flag check when disabled, one lock + deque append when
     enabled (the deque's maxlen does the eviction — no manual trimming)."""
 
+    # ring + seq move together under the lock (analysis/locks.py)
+    _GUARDED_BY = {"_ring": "_lock", "_seq": "_lock"}
+
     def __init__(self, capacity: int = DEFAULT_CAPACITY, enabled: bool = False):
         self.enabled = enabled
         self._lock = threading.Lock()
@@ -50,6 +53,8 @@ class FlightRecorder:
 
     @property
     def capacity(self) -> int:
+        # gol: allow(lock-discipline): maxlen is fixed at construction —
+        # reading it races nothing
         return self._ring.maxlen
 
     def record(self, kind: str, name: str, **args) -> None:

@@ -73,6 +73,10 @@ _REQUIRED_SECTIONS = (
     "Sessions",
     "SLOs & alerting",
     "## Doctor",
+    # the analysis/ checker suite's operator contract: checker table,
+    # suppression syntax, how to add a checker (lint-enforced like the
+    # metric tables — analysis/lints.py checks the checker ids are IN it)
+    "## Static analysis",
 )
 
 # the wire data-plane metric families (rpc/protocol.py frames + the
@@ -204,69 +208,86 @@ def missing_readme_sections(readme_path=None) -> List[str]:
     return [s for s in _REQUIRED_SECTIONS if s not in text]
 
 
+# the lint suite, named: ``(check id, function, fail message, ok message)``.
+# The ids are the analysis-framework handles — gol_distributed_final_tpu/
+# analysis/lints.py re-seats every entry as a repo-level checker under the
+# same runner/finding/suppression contract as the AST checkers, so this
+# table is the single registry both surfaces share (``scripts/check``
+# default + ``--lint`` alias, one behavior).
+CHECKS = (
+    (
+        "lint-metrics",
+        undocumented_metrics,
+        "metrics registered in obs/instruments.py but missing from "
+        "README.md's Observability table:",
+        "metric-name lint ok: every registered metric is documented",
+    ),
+    (
+        "lint-spans",
+        undocumented_spans,
+        "span names declared in obs/tracing.py but missing from "
+        "README.md's Tracing table:",
+        "span-name lint ok: every declared span name is documented",
+    ),
+    (
+        "lint-device-metrics",
+        undocumented_device_metrics,
+        "device metrics registered in obs/instruments.py but missing "
+        "from README.md's Device telemetry table:",
+        "device-metric lint ok: every device metric is in the Device "
+        "telemetry table",
+    ),
+    (
+        "lint-wire-metrics",
+        undocumented_wire_metrics,
+        "wire data-plane metrics missing from README.md's Wire modes "
+        "section:",
+        "wire-metric lint ok: every wire metric is in the Wire modes "
+        "section",
+    ),
+    (
+        "lint-integrity-metrics",
+        undocumented_integrity_metrics,
+        "integrity metrics missing from README.md's Integrity "
+        "section:",
+        "integrity-metric lint ok: every integrity metric is in the "
+        "Integrity section",
+    ),
+    (
+        "lint-session-metrics",
+        undocumented_session_metrics,
+        "session metrics missing from README.md's Sessions section:",
+        "session-metric lint ok: every session metric is in the "
+        "Sessions section",
+    ),
+    (
+        "lint-slo-metrics",
+        undocumented_slo_metrics,
+        "SLO metrics missing from README.md's SLOs & alerting "
+        "section:",
+        "slo-metric lint ok: every SLO metric is in the SLOs & "
+        "alerting section",
+    ),
+    (
+        "lint-slo-rules",
+        undocumented_slo_rules,
+        "default SLO rule names missing from README.md's SLOs & "
+        "alerting section:",
+        "slo-rule lint ok: every default rule name is in the SLOs & "
+        "alerting section",
+    ),
+    (
+        "lint-sections",
+        missing_readme_sections,
+        "required README sections missing:",
+        "section lint ok: every required README section present",
+    ),
+)
+
+
 def main(argv=None) -> int:
-    checks = (
-        (
-            undocumented_metrics,
-            "metrics registered in obs/instruments.py but missing from "
-            "README.md's Observability table:",
-            "metric-name lint ok: every registered metric is documented",
-        ),
-        (
-            undocumented_spans,
-            "span names declared in obs/tracing.py but missing from "
-            "README.md's Tracing table:",
-            "span-name lint ok: every declared span name is documented",
-        ),
-        (
-            undocumented_device_metrics,
-            "device metrics registered in obs/instruments.py but missing "
-            "from README.md's Device telemetry table:",
-            "device-metric lint ok: every device metric is in the Device "
-            "telemetry table",
-        ),
-        (
-            undocumented_wire_metrics,
-            "wire data-plane metrics missing from README.md's Wire modes "
-            "section:",
-            "wire-metric lint ok: every wire metric is in the Wire modes "
-            "section",
-        ),
-        (
-            undocumented_integrity_metrics,
-            "integrity metrics missing from README.md's Integrity "
-            "section:",
-            "integrity-metric lint ok: every integrity metric is in the "
-            "Integrity section",
-        ),
-        (
-            undocumented_session_metrics,
-            "session metrics missing from README.md's Sessions section:",
-            "session-metric lint ok: every session metric is in the "
-            "Sessions section",
-        ),
-        (
-            undocumented_slo_metrics,
-            "SLO metrics missing from README.md's SLOs & alerting "
-            "section:",
-            "slo-metric lint ok: every SLO metric is in the SLOs & "
-            "alerting section",
-        ),
-        (
-            undocumented_slo_rules,
-            "default SLO rule names missing from README.md's SLOs & "
-            "alerting section:",
-            "slo-rule lint ok: every default rule name is in the SLOs & "
-            "alerting section",
-        ),
-        (
-            missing_readme_sections,
-            "required README sections missing:",
-            "section lint ok: every required README section present",
-        ),
-    )
     rc = 0
-    for check, fail_msg, ok_msg in checks:
+    for _check_id, check, fail_msg, ok_msg in CHECKS:
         missing = check()
         if missing:
             print(fail_msg, file=sys.stderr)

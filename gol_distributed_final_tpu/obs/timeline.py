@@ -44,12 +44,15 @@ rulebook that runs after every tick.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from . import metrics as _metrics
+
+logger = logging.getLogger(__name__)
 
 #: default sampling cadence (seconds) — the ``-timeline`` flags' implied
 #: value; one registry snapshot per tick
@@ -188,6 +191,16 @@ class TimelineSampler:
     opportunistic ``maybe_sample`` site). All public queries take the
     internal lock; sampling is O(registry snapshot)."""
 
+    # the ring state mutates under _lock during ticks while Status polls
+    # iterate it — the exact 'deque mutated during iteration' race the
+    # PR 8 review fixed, now machine-enforced (analysis/locks.py)
+    _GUARDED_BY = {
+        "_series": "_lock",
+        "_labelnames": "_lock",
+        "_seq": "_lock",
+        "_prev_stamp": "_lock",
+    }
+
     def __init__(
         self,
         registry=None,
@@ -219,6 +232,9 @@ class TimelineSampler:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._rulebook = None  # obs/slo.RuleBook, attached by enable()
+        # rulebook-failure tally (tick-lock serialised): paces the
+        # warning log so a per-tick rule bug doesn't flood stderr
+        self._rule_errors = 0
 
     # -- sampling ----------------------------------------------------------
 
@@ -285,8 +301,25 @@ class TimelineSampler:
             # through the public query surface
             try:
                 rb.evaluate(self, now=t_mono, wall=t_unix)
-            except Exception:  # an alert bug must never kill the sampler
-                pass
+            except Exception as exc:
+                # an alert bug must never kill the sampler — but it must
+                # leave evidence UNCONDITIONALLY: the flight ring only
+                # records when the trace flags enabled it, so the log
+                # line (paced: first failure, then every 60th — the
+                # broker's outage-log posture, since this fires per tick)
+                # is what guarantees a broken rulebook is visible instead
+                # of silently never paging again
+                self._rule_errors += 1
+                if self._rule_errors == 1 or self._rule_errors % 60 == 0:
+                    logger.warning(
+                        "SLO rulebook evaluation failed (%d time(s)): %s",
+                        self._rule_errors, exc,
+                    )
+                from . import flight
+
+                flight.record(
+                    "slo.error", "rulebook", error=str(exc)[:200]
+                )
         return seq
 
     def maybe_sample(self) -> bool:
@@ -324,15 +357,20 @@ class TimelineSampler:
                 # an opportunistic site may have just ticked; don't double
                 if time.monotonic() - self._last_t >= 0.5 * self.period:
                     self.sample_once()
+            # gol: allow(hygiene): the 1 Hz sampler loop must survive
+            # registry bugs; recording each period would churn the
+            # flight ring — the rulebook path above records once
             except Exception:  # pragma: no cover - registry bugs
                 pass
 
     # -- queries (the obs/slo.py rule surface) -----------------------------
 
-    def _rings(self, name: str, labels=None) -> List[_SeriesRing]:
+    def _rings(self, name: str, labels=None) -> List[_SeriesRing]:  # gol: holds(_lock)
         """Matching rings. Caller must hold ``self._lock`` across BOTH
         this call and any iteration of the returned rings' deques — a
-        sample tick appends under the same lock."""
+        sample tick appends under the same lock (every query above/below
+        wraps this call in ``with self._lock`` — the holds() marker
+        declares that caller contract to analysis/locks.py)."""
         return [
             ring
             for (n, lv), ring in self._series.items()

@@ -42,6 +42,8 @@ def initialize(
     # a jax without it simply keeps its default.
     try:
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    # gol: allow(hygiene): version-dependent option probe — a jax
+    # without it keeps its default, which is the documented contract
     except Exception:
         pass
     jax.distributed.initialize(

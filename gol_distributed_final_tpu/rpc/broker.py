@@ -245,6 +245,17 @@ class WorkersBackend:
     comms/compute amortisation the mesh planes' wide halos buy on-device
     (parallel/halo.py), honored on this backend for the first time."""
 
+    # the roster maps (who is lost, each address's probe schedule, the
+    # client->address index) mutate from the turn loop, the probe thread,
+    # and RPC handler threads at once: every touch goes through _lock —
+    # entered directly or via the _control Condition wrapping it
+    # (machine-enforced: analysis/locks.py)
+    _GUARDED_BY = {
+        "_lost": ("_lock", "_control"),
+        "_probe_backoff": ("_lock", "_control"),
+        "_client_addr": ("_lock", "_control"),
+    }
+
     def __init__(
         self,
         worker_addresses: list[str],
@@ -288,7 +299,7 @@ class WorkersBackend:
         self._probe_interval = probe_interval
         self._turn_seconds: float | None = None  # EWMA, turn-loop-local
         self._last_ckpt = 0.0
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # guards the roster maps (_GUARDED_BY)
         self._control = threading.Condition(self._lock)
         # the FULL roster is kept (not just the connected subset): a dead
         # or flapping address stays probe-able, so capacity recovers when
@@ -345,7 +356,8 @@ class WorkersBackend:
                 "this wire mode has no halo_depth knob; use -wire resident "
                 "(or -backend tpu) for wide halos"
             )
-        if getattr(req, "rulestring", ""):
+        rulestring = getattr(req, "rulestring", "")
+        if rulestring:
             # the reference-shaped workers hard-code Conway
             # (worker/worker.go:41-46, mirrored in rpc/worker._strip_step);
             # silently evolving a resumed non-Conway checkpoint would
@@ -354,7 +366,7 @@ class WorkersBackend:
             from ..models import CONWAY, LifeRule
 
             try:
-                canonical = LifeRule.from_rulestring(req.rulestring).rulestring
+                canonical = LifeRule.from_rulestring(rulestring).rulestring
             except ValueError as e:
                 raise RpcError(str(e)) from e
             if canonical != CONWAY.rulestring:
@@ -1007,7 +1019,10 @@ class WorkersBackend:
                         if counts:
                             total += int(counts[-1])
                     for i, res in enumerate(results):
-                        plan.edges[i] = (res.edges[:k], res.edges[k:])
+                        # shape/None-validated in the reply loop above;
+                        # getattr keeps the read skew-safe regardless
+                        edges = getattr(res, "edges", None)
+                        plan.edges[i] = (edges[:k], edges[k:])
                         # advance the digest chain to the committed turn
                         # (None = this worker stopped attesting: the chain
                         # is no longer checkable for it, never guessed)
@@ -1103,6 +1118,8 @@ class WorkersBackend:
         thread for readmission."""
         try:
             client.close()
+        # gol: allow(hygiene): best-effort close of an already-dead
+        # transport — the loss itself is logged + metered just below
         except Exception:
             pass
         with self._lock:
@@ -1303,6 +1320,8 @@ class WorkersBackend:
                 pass
             try:
                 client.close()
+            # gol: allow(hygiene): best-effort close during cluster
+            # teardown — the quit fan-out above already reported
             except Exception:
                 pass
         # lost-but-ALIVE workers (deadline-evicted, quarantined, not yet
@@ -1336,6 +1355,8 @@ class WorkersBackend:
         for client in clients:
             try:
                 client.close()
+            # gol: allow(hygiene): best-effort broker-side release —
+            # workers keep running by contract, nothing to report
             except Exception:
                 pass
 
@@ -1414,6 +1435,16 @@ class SessionScheduler:
     concurrent Retrieve with the same tag serves THAT universe's
     per-session snapshot — the AliveCellsCount ticker contract, per
     universe."""
+
+    # scheduler state moves under ONE lock, entered either directly or
+    # through the _work Condition wrapping it (analysis/locks.py
+    # accepts both context managers as the same guard)
+    _GUARDED_BY = {
+        "_table": ("_lock", "_work"),
+        "_tags": ("_lock", "_work"),
+        "_stop": ("_lock", "_work"),
+        "_thread": ("_lock", "_work"),
+    }
 
     def __init__(self, capacity: int = 256, max_chunk: int = 4096):
         if capacity < 1:

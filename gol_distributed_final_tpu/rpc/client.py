@@ -368,6 +368,10 @@ class RpcClient:
             )
             err.is_reply = True  # a reply arrived: the peer is alive
             raise err
+        # gol: allow(skew-safety): 'result' is a REQUIRED key of every
+        # non-error reply in every protocol version — a missing key is a
+        # malformed envelope that must fail loudly, not default to None
+        # (None is a legitimate result value)
         return reply["result"]
 
     def close(self) -> None:

@@ -127,6 +127,15 @@ def strip_step_batch(
 
 
 class WorkerService:
+    # the resident-strip session state moves as one unit under its lock
+    # (machine-enforced: analysis/locks.py flags any access outside
+    # 'with self._strip_lock')
+    _GUARDED_BY = {
+        "_strip": "_strip_lock",
+        "_strip_turn": "_strip_lock",
+        "_strip_index": "_strip_lock",
+    }
+
     def __init__(self, server: RpcServer):
         self._server = server
         self.quit_event = threading.Event()
@@ -167,9 +176,13 @@ class WorkerService:
             raise ValueError(f"strip must be a 2-D row block, got {strip.shape}")
         with self._strip_lock:
             self._strip = strip
-            self._strip_turn = getattr(req, "initial_turn", 0)
+            turn = self._strip_turn = getattr(req, "initial_turn", 0)
             self._strip_index = req.worker
-        return Response(worker=req.worker, turns_completed=self._strip_turn)
+        # reply with the turn captured UNDER the lock: a concurrent
+        # StripStep landing between release and reply must not make this
+        # seed acknowledgment claim the stepped turn (analysis/locks.py
+        # caught the original unlocked read)
+        return Response(worker=req.worker, turns_completed=turn)
 
     def strip_step(self, req: Request) -> Response:
         """Advance the resident strip ``req.turns`` turns given depth-K halo
@@ -264,7 +277,10 @@ class WorkerService:
             )
 
     def worker_quit(self, req: Request) -> Response:
-        # reply first, then shut the listener (worker/worker.go:82-86)
+        # reply first, then shut the listener (worker/worker.go:82-86).
+        # gol: allow(hygiene): deliberately NON-daemon — the timer must
+        # outlive this handler so the quit reply flushes before the
+        # process exits; it fires once, 50 ms later, then the thread ends
         threading.Timer(0.05, self._shutdown).start()
         return Response()
 

@@ -51,6 +51,7 @@ def force_virtual_cpu_devices(n_devices: int) -> bool:
         jax.config.update("jax_platforms", "cpu")
         devices = jax.devices()
         ok = len(devices) >= n_devices and devices[0].platform == "cpu"
+    # gol: allow(hygiene): capability probe — 'no' is a normal answer
     except Exception:
         ok = False
     if not ok:
@@ -61,6 +62,8 @@ def force_virtual_cpu_devices(n_devices: int) -> bool:
                 os.environ[k] = v
         try:
             jax.config.update("jax_platforms", saved_platforms)
+        # gol: allow(hygiene): backends already initialised makes the
+        # config restore inert — nothing to report
         except Exception:
-            pass  # backends already initialised; config change was inert
+            pass
     return ok
