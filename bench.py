@@ -507,6 +507,139 @@ def _bench_sessions(extra: dict) -> int:
     return 0
 
 
+def _bench_loadgen(extra: dict) -> int:
+    """Open-loop serving (config 9): the obs/loadgen.py generator against
+    a loopback broker — the FULL client path (RPC frames, admission,
+    batched session driver, tagged retrieves), not a kernel call.
+
+    Two numbers ride into BENCH_r*.json:
+
+    * the bench_diff-gated fit: marginal per-SESSION cost over a
+      SERIAL schedule (max_inflight=1 — one session at a time, so the
+      batch shapes the driver compiles stay fixed at B=1 and the fit is
+      shape-stable run to run). This is the serving-path latency floor
+      per session: RPC round-trip + admission + a batch-of-one's chunk
+      chain — the overhead the batch axis amortises.
+    * the serving story as extras: a concurrent burst (min over reps →
+      ``sessions_per_s``) and an open-loop Poisson run at ~50%% of that
+      measured capacity, whose client-side
+      ``p99_admit_to_first_turn_us`` is the ROADMAP front-door
+      objective measured for the first time — the number every
+      admission-control stage will be gated against.
+    """
+    from gol_distributed_final_tpu.obs import accounting as obs_accounting
+    from gol_distributed_final_tpu.obs import metrics as obs_metrics
+    from gol_distributed_final_tpu.obs.loadgen import LoadConfig, LoadGenerator
+    from gol_distributed_final_tpu.obs.status import scalar_value
+    from gol_distributed_final_tpu.rpc.broker import serve
+
+    def session_turns_metric() -> float:
+        return scalar_value(
+            obs_metrics.registry().snapshot(), "gol_session_turns_total"
+        ) or 0.0
+
+    obs_metrics.enable()  # idempotent; the ledger + meters must record
+    obs_accounting.ledger().reset()
+    # delta baseline: config 8 already moved the session counter; the
+    # freshly-reset ledger must match THIS config's increment only
+    turns_before = session_turns_metric()
+    server, service = serve(port=0, session_capacity=1024)
+    addr = f"127.0.0.1:{server.port}"
+    size, turns = 16, 16
+    try:
+        def run_serial(n):
+            summary = LoadGenerator(addr, LoadConfig(
+                rate=1e6, sessions=n, arrival="burst", burst=1,
+                tenants=4, size=size, turns=turns, seed=11,
+                max_inflight=1,
+            )).run()
+            if summary["completed"] != n:
+                raise InvalidMeasurement(
+                    f"loadgen serial floor: only {summary['completed']}/{n} "
+                    f"sessions completed ({summary['rejected_total']} "
+                    f"rejected, {summary['errors']} errors)"
+                )
+
+        n_lo, n_hi = 20, 120
+        run_serial(n_lo), run_serial(n_hi)  # warm the B=1 chunk shapes
+        per_session, det = gated(run_serial, n_lo, n_hi, "c9_loadgen_open_loop")
+
+        # concurrent burst: the serving capacity number (min over reps —
+        # untimed-gated extras, like c8's sessions_per_s)
+        burst_n, t_burst = 200, None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            summary = LoadGenerator(addr, LoadConfig(
+                rate=1e6, sessions=burst_n, arrival="burst", burst=burst_n,
+                tenants=8, size=size, turns=turns, seed=12,
+            )).run()
+            if summary["completed"] != burst_n:
+                print(
+                    f"LOADGEN BURST FAILURE: {summary['completed']}/"
+                    f"{burst_n} completed", file=sys.stderr,
+                )
+                return 1
+            dt = time.perf_counter() - t0
+            t_burst = dt if t_burst is None else min(t_burst, dt)
+        sessions_per_s = burst_n / t_burst
+
+        # open-loop Poisson at ~50% of measured capacity: queueing is
+        # real but bounded, so the p99 is a serving number, not a
+        # saturation artifact
+        rate = max(20.0, min(2000.0, 0.5 * sessions_per_s))
+        poisson = LoadGenerator(addr, LoadConfig(
+            rate=rate, sessions=300, arrival="poisson", tenants=8,
+            tenant_dist="zipf", size=size, turns=turns, seed=13,
+        )).run()
+        if poisson["errors"]:
+            print(
+                f"LOADGEN POISSON FAILURE: {poisson['errors']} error(s)",
+                file=sys.stderr,
+            )
+            return 1
+        att = poisson["admit_to_first_turn"]
+        e2e = poisson["session_e2e"]
+        extra["c9_loadgen_open_loop"] = dict(
+            det,
+            unit_note="per_turn_us is per SESSION (serial floor): the "
+            "full-RPC-path serving cost one session pays alone",
+            sessions_per_s=round(sessions_per_s, 1),
+            serial_sessions_per_s=round(1.0 / per_session, 1),
+            concurrency_speedup=round(per_session * sessions_per_s, 1),
+            open_loop_rate_per_s=round(rate, 1),
+            p99_admit_to_first_turn_us=att.get("p99_us"),
+            p50_admit_to_first_turn_us=att.get("p50_us"),
+            p99_session_us=e2e.get("p99_us"),
+            rejected=poisson["rejected_total"],
+            tenants=8,
+        )
+        print(
+            f"loadgen ok: serial floor {per_session * 1e3:.2f} ms/session, "
+            f"{sessions_per_s:,.0f} sessions/s burst, open-loop p99 "
+            f"admit-to-first-turn {att.get('p99_us', 0) / 1e3:.1f} ms "
+            f"at {rate:.0f}/s", file=sys.stderr,
+        )
+        # reconciliation ride-along: the accounting ledger must agree
+        # with the session meters after thousands of sessions (the
+        # loadgen selfcheck contract, asserted here on TPU too)
+        turns_delta = session_turns_metric() - turns_before
+        ledger_turns = obs_accounting.ledger().totals().get("turns")
+        if not ledger_turns or ledger_turns != int(turns_delta):
+            print(
+                f"LOADGEN LEDGER FAILURE: ledger turns {ledger_turns} != "
+                f"gol_session_turns_total delta {int(turns_delta)}",
+                file=sys.stderr,
+            )
+            return 1
+        print(
+            f"ledger reconciles: {ledger_turns} universe-turns attributed",
+            file=sys.stderr,
+        )
+    finally:
+        service._shutdown()
+    return 0
+
+
 def main(argv=None) -> int:
     import argparse
     import contextlib
@@ -758,6 +891,11 @@ def _bench_body() -> int:
 
     # ---- config 8: multi-universe serving — 1k x 128^2 batched sessions --
     rc = _bench_sessions(extra)
+    if rc:
+        return rc
+
+    # ---- config 9: open-loop serving — loadgen vs a loopback broker ------
+    rc = _bench_loadgen(extra)
     if rc:
         return rc
 

@@ -46,6 +46,8 @@ _SECTION_ANCHORS = {
     "lint-session-metrics": "## Sessions",
     "lint-slo-metrics": "## SLOs & alerting",
     "lint-slo-rules": "## SLOs & alerting",
+    "lint-canary-metrics": "## Canary & load harness",
+    "lint-accounting-docs": "## Accounting & capacity",
 }
 
 

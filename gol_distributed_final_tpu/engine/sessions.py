@@ -52,6 +52,7 @@ import numpy as np
 
 from ..events import AliveCellsCount, FinalTurnComplete, TurnComplete
 from ..models import CONWAY, LifeRule
+from ..obs import accounting as _acct
 from ..obs import instruments as _ins
 
 #: admission-refusal reasons — the stable label set of
@@ -82,7 +83,7 @@ class Session:
 
     __slots__ = (
         "sid", "turns", "turns_done", "alive_count", "done", "result",
-        "cancelled", "error", "on_event",
+        "cancelled", "error", "on_event", "tenant",
     )
 
     def __init__(
@@ -92,6 +93,7 @@ class Session:
         initial_turn: int,
         alive_count: int,
         on_event: Optional[Callable] = None,
+        tenant: str = "-",
     ):
         self.sid = sid
         self.turns = turns  # the budget: total turns this session runs to
@@ -102,6 +104,10 @@ class Session:
         self.cancelled = False
         self.error: Optional[Exception] = None
         self.on_event = on_event
+        # the accounting identity (obs/accounting.tenant_of of the
+        # client-chosen session tag): every chunk this session rides
+        # attributes its share of the dispatch wall to this tenant
+        self.tenant = tenant
 
     @property
     def remaining(self) -> int:
@@ -154,7 +160,11 @@ class SessionTable:
     # -- admission control ------------------------------------------------
 
     def admit(
-        self, board, turns: int, on_event: Optional[Callable] = None
+        self,
+        board,
+        turns: int,
+        on_event: Optional[Callable] = None,
+        tenant: str = "-",
     ) -> Session:
         """Admission-controlled join. The universe enters the device batch
         at the next ``advance`` boundary; until then snapshots serve its
@@ -176,7 +186,7 @@ class SessionTable:
                 )
             sess = Session(
                 self._next_sid, turns, 0, int(np.count_nonzero(board)),
-                on_event,
+                on_event, tenant,
             )
             self._next_sid += 1
             self._pending.append((sess, board.copy()))
@@ -242,26 +252,19 @@ class SessionTable:
             state = self._plane.step_n(state, k)
         # ONE batched reduction; every per-session count demuxes from it
         counts = self._plane.alive_counts(state)
-        if k > 0:
-            # the serving-latency objective (obs/slo.py session-turn-
-            # latency rule): this chunk's wall — the reduction forces the
-            # dispatch, so it is real time, not enqueue time — normalized
-            # per universe-turn; count == universe-turns, matching
-            # gol_session_turns_total, so rates agree across the two
-            m = sum(1 for s in active if not s.cancelled)
-            if m:
-                _ins.SESSION_TURN_SECONDS.observe_n(
-                    (time.monotonic() - t_chunk) / (k * m), k * m
-                )
+        dt_chunk = time.monotonic() - t_chunk  # the reduction forces the
+        # dispatch, so this is real time, not enqueue time
 
         events: List[tuple[Session, object]] = []
         finished: List[int] = []
+        advanced: List[str] = []  # tenant per universe this chunk advanced
         with self._lock:
             self._state = state
             for i, s in enumerate(active):
                 if k > 0 and not s.cancelled:
                     s.turns_done += k
                     s.alive_count = int(counts[i])
+                    advanced.append(s.tenant)
                     if s.on_event is not None:
                         events.append(
                             (s, AliveCellsCount(s.turns_done, s.alive_count))
@@ -269,10 +272,20 @@ class SessionTable:
                         events.append((s, TurnComplete(s.turns_done)))
                 if s.cancelled or s.remaining == 0:
                     finished.append(i)
-            if k > 0:
-                _ins.SESSION_TURNS_TOTAL.inc(
-                    k * sum(1 for s in active if not s.cancelled)
-                )
+            if advanced:
+                _ins.SESSION_TURNS_TOTAL.inc(k * len(advanced))
+        if advanced:
+            # the serving-latency objective (obs/slo.py session-turn-
+            # latency rule): the chunk wall normalized per universe-turn,
+            # count == universe-turns — and the per-tenant attribution
+            # (obs/accounting.py): the SAME wall, split evenly. All
+            # three meters derive from the ONE `advanced` list the lock
+            # committed, so ledger turns reconcile with
+            # gol_session_turns_total EXACTLY even when a cancel() races
+            # the chunk boundary.
+            m = len(advanced)
+            _ins.SESSION_TURN_SECONDS.observe_n(dt_chunk / (k * m), k * m)
+            _acct.ledger().record_chunk(advanced, k, dt_chunk)
 
         # retire + compact: ONE gather + ONE decode for every finishing
         # universe (a burst of equal budgets retiring together must not

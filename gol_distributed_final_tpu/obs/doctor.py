@@ -34,21 +34,13 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from .status import StatusUnavailable, fetch_status
+from .status import norm_address as _norm_addr
 from .status import scalar_value as _scalar
 from .status import series_map as _series_map
 
 SCHEMA = "gol-doctor/1"
 
 _SEVERITY_ORDER = {"page": 0, "warn": 1, "info": 2}
-
-
-def _norm_addr(address: str) -> str:
-    """Accept ``tcp://host:port``, ``host:port``, and ``:port``."""
-    if address.startswith("tcp://"):
-        address = address[len("tcp://"):]
-    if address.startswith(":"):
-        address = "127.0.0.1" + address
-    return address
 
 
 def collect(
@@ -307,6 +299,80 @@ def _find_stall(statuses) -> List[dict]:
     return out
 
 
+def _find_tenant_skew(statuses) -> List[dict]:
+    """The hot-tenant correlation (the 'names the flapping worker'
+    pattern, applied to the accounting ledger): one tenant holding the
+    majority of device-seconds, or driving the dominant reject / burn
+    share, is named with its ledger evidence rows — the operator's
+    first question when the error budget burns is WHO."""
+    out = []
+    for label, payload in statuses.items():
+        acct = payload.get("accounting") or {}
+        tenants = acct.get("tenants") or []
+        other = acct.get("other")
+        totals = acct.get("totals") or {}
+        entries = tenants + ([other] if other else [])
+        if len(entries) < 2:
+            continue  # one tenant IS 100% of everything — not skew
+
+        def ev(e: dict) -> str:
+            return (
+                f"tenant {e.get('tenant', '?')}: "
+                f"{e.get('device_seconds') or 0.0:.3f} dev-s, "
+                f"{int(e.get('turns') or 0)} turns, "
+                f"{int(e.get('rejects_total') or 0)} reject(s), "
+                f"{int(e.get('errors') or 0)} error(s)"
+            )
+
+        total_dev = totals.get("device_seconds") or 0.0
+        top = max(entries, key=lambda e: e.get("device_seconds") or 0.0)
+        if total_dev > 0:
+            share = (top.get("device_seconds") or 0.0) / total_dev
+            if share > 0.5 and top is not other:
+                out.append(_finding(
+                    "warn", 64.0 + 20.0 * share,
+                    f"tenant {top.get('tenant', '?')} holds "
+                    f"{100 * share:.0f}% of device-seconds",
+                    "one tenant dominates the batch's capacity: every "
+                    "other tenant's admission waits and turn latency "
+                    "ride behind it. Per-tenant admission quotas are "
+                    "the fix the ROADMAP front door plans.",
+                    [ev(e) for e in entries[:3]]
+                    + [f"ledger totals: {total_dev:.3f} dev-s, "
+                       f"{int(totals.get('turns') or 0)} turns"],
+                    [f"tenant {top.get('tenant', '?')}"], label,
+                ))
+        total_rej = totals.get("rejects") or 0
+        total_err = totals.get("errors") or 0
+        burn_total = total_rej + total_err
+        if burn_total >= 5:
+            hot = max(
+                entries,
+                key=lambda e: (e.get("rejects_total") or 0)
+                + (e.get("errors") or 0),
+            )
+            hot_burn = (hot.get("rejects_total") or 0) + (hot.get("errors") or 0)
+            if hot_burn / burn_total > 0.5 and hot is not other:
+                reasons = ", ".join(
+                    f"{k} {v}"
+                    for k, v in sorted((hot.get("rejects") or {}).items())
+                ) or "errors only"
+                out.append(_finding(
+                    "warn", 60.0,
+                    f"tenant {hot.get('tenant', '?')} drives "
+                    f"{100 * hot_burn / burn_total:.0f}% of the "
+                    "reject/error burn",
+                    "the error-budget burn is one tenant's traffic "
+                    f"({reasons}), not global overload: shed or quota "
+                    "that tenant before raising -session-capacity.",
+                    [ev(hot)]
+                    + [f"cluster burn: {total_rej} reject(s) + "
+                       f"{total_err} error(s)"],
+                    [f"tenant {hot.get('tenant', '?')}"], label,
+                ))
+    return out
+
+
 def _find_hbm(statuses) -> List[dict]:
     out = []
     for label, payload in statuses.items():
@@ -360,6 +426,7 @@ _HEURISTICS = (
     _find_integrity,
     _find_alerts,
     _find_error_ratio,
+    _find_tenant_skew,
     _find_stall,
     _find_hbm,
     _find_checkpoint,

@@ -220,6 +220,45 @@ SLO_ALERTS_TOTAL = _R.counter(
     labelnames=("rule", "severity"),
 )
 
+# -- blackbox canary + open-loop load harness (obs/canary.py,
+#    obs/loadgen.py) ---------------------------------------------------------
+
+CANARY_PROBES_TOTAL = _R.counter(
+    "gol_canary_probes_total",
+    "Blackbox canary probes (obs/canary.py: a known-oracle universe "
+    "through the full RPC + session path), by result: 'ok' (bit-exact), "
+    "'corrupt' (the serving path returned WRONG bits — the silent class "
+    "the 'canary-failure' SLO rule pages on), 'error' (the path failed "
+    "loudly: transport/reply error or timeout).",
+    labelnames=("result",),
+)
+CANARY_LATENCY_SECONDS = _R.histogram(
+    "gol_canary_latency_seconds",
+    "End-to-end canary probe latency (submit to verified final board), "
+    "success or failure — a slow canary is an early latency signal from "
+    "the exact path tenants use.",
+)
+LOADGEN_ADMIT_TO_FIRST_TURN_SECONDS = _R.histogram(
+    "gol_loadgen_admit_to_first_turn_seconds",
+    "CLIENT-side admission-to-first-turn latency measured by the "
+    "open-loop load generator (obs/loadgen.py): session arrival to the "
+    "first turn visible via the tagged retrieve poller (quantized by "
+    "the poll cadence; a session that drains before the first poll "
+    "records its end-to-end wall) — the ROADMAP front-door objective.",
+)
+LOADGEN_SESSION_SECONDS = _R.histogram(
+    "gol_loadgen_session_seconds",
+    "CLIENT-side end-to-end session latency measured by the open-loop "
+    "load generator: arrival to final board.",
+)
+LOADGEN_SESSIONS_TOTAL = _R.counter(
+    "gol_loadgen_sessions_total",
+    "Load-generator session outcomes, by 'ok' / 'rejected' (structured "
+    "SessionRejected reply — reasons break out in the loadgen summary "
+    "and the per-tenant accounting ledger) / 'error'.",
+    labelnames=("outcome",),
+)
+
 # -- data integrity (rpc/integrity.py: checked frames, attestation,
 #    verified checkpoints) ---------------------------------------------------
 

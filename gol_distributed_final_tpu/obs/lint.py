@@ -77,6 +77,13 @@ _REQUIRED_SECTIONS = (
     # suppression syntax, how to add a checker (lint-enforced like the
     # metric tables — analysis/lints.py checks the checker ids are IN it)
     "## Static analysis",
+    # the tenant-attribution contract (obs/accounting.py): the session-tag
+    # packing convention, the top-K cardinality bound, the Status payload
+    # size budget, and the reconciliation guarantees
+    "## Accounting & capacity",
+    # the blackbox measurement surface (obs/canary.py + obs/loadgen.py):
+    # probe verbs, metric tables, loadgen CLI examples
+    "## Canary & load harness",
 )
 
 # the wire data-plane metric families (rpc/protocol.py frames + the
@@ -200,6 +207,45 @@ def undocumented_slo_rules(readme_path=None) -> List[str]:
     return sorted(n for n in DEFAULT_RULE_NAMES if n not in section)
 
 
+# the blackbox measurement metric families (obs/canary.py prober +
+# obs/loadgen.py generator): these must be documented in the README's
+# "Canary & load harness" section specifically — the operator contract
+# for the end-to-end correctness probe and the arrival-process harness
+_CANARY_METRIC_NAMES = (
+    "gol_canary_probes_total",
+    "gol_canary_latency_seconds",
+    "gol_loadgen_admit_to_first_turn_seconds",
+    "gol_loadgen_session_seconds",
+    "gol_loadgen_sessions_total",
+)
+
+
+def undocumented_canary_metrics(readme_path=None) -> List[str]:
+    """Canary/loadgen metric names missing from the README's "Canary &
+    load harness" section specifically (the wire/device-table posture:
+    a name mentioned elsewhere does not count as documented here)."""
+    section = _readme_section(readme_path, "## Canary & load harness")
+    return sorted(n for n in _CANARY_METRIC_NAMES if n not in section)
+
+
+# the accounting section's contract names: the ledger attributes the
+# session meters per tenant, so its section of record must name the
+# meters it reconciles against (and the wire field polls echo)
+_ACCOUNTING_DOC_NAMES = (
+    "gol_sessions_rejected_total",
+    "gol_session_turns_total",
+    "gol_session_turn_seconds",
+    "accounting_since",
+)
+
+
+def undocumented_accounting_names(readme_path=None) -> List[str]:
+    """Reconciliation-contract names missing from the README's
+    "Accounting & capacity" section specifically."""
+    section = _readme_section(readme_path, "## Accounting & capacity")
+    return sorted(n for n in _ACCOUNTING_DOC_NAMES if n not in section)
+
+
 def missing_readme_sections(readme_path=None) -> List[str]:
     """Required operator-facing README sections that are absent."""
     if readme_path is None:
@@ -275,6 +321,22 @@ CHECKS = (
         "alerting section:",
         "slo-rule lint ok: every default rule name is in the SLOs & "
         "alerting section",
+    ),
+    (
+        "lint-canary-metrics",
+        undocumented_canary_metrics,
+        "canary/loadgen metrics missing from README.md's Canary & load "
+        "harness section:",
+        "canary-metric lint ok: every canary/loadgen metric is in the "
+        "Canary & load harness section",
+    ),
+    (
+        "lint-accounting-docs",
+        undocumented_accounting_names,
+        "accounting-contract names missing from README.md's Accounting "
+        "& capacity section:",
+        "accounting lint ok: the reconciliation contract is documented "
+        "in the Accounting & capacity section",
     ),
     (
         "lint-sections",

@@ -21,12 +21,14 @@ import pathlib
 import time
 from typing import Optional
 
-from . import flight, metrics, timeline, tracing
+from . import accounting, flight, metrics, timeline, tracing
 
 SCHEMA = "gol-run-report/1"
 
 
-def status_payload(timeline_since: int = 0, **extra) -> dict:
+def status_payload(
+    timeline_since: int = 0, accounting_since: int = 0, **extra
+) -> dict:
     """The ``Status`` verb's reply body: registry snapshot + identity.
 
     Deliberately jax-free: a worker process that never imported jax must
@@ -65,6 +67,12 @@ def status_payload(timeline_since: int = 0, **extra) -> dict:
         payload["timeline"] = sampler.window(since=timeline_since)
         if sampler.rulebook is not None:
             payload["alerts"] = sampler.rulebook.snapshot()
+    ledger = accounting.ledger()
+    if ledger.has_data:
+        # the per-tenant usage ledger (obs/accounting.py) — incremental
+        # past the caller's accounting_since seq, bounded at top-K
+        # tenants + the 'other' bucket either way
+        payload["accounting"] = ledger.window(since=accounting_since)
     payload.update(extra)
     return payload
 
@@ -181,6 +189,11 @@ def write_run_report(
             report["alerts_fired"] = sorted(
                 a["rule"] for a in alerts if a.get("fired_total")
             )
+    ledger = accounting.ledger()
+    if ledger.has_data:
+        # who spent this run's capacity: the bounded per-tenant ledger
+        # rides the final artifact beside the timeline verdict
+        report["accounting"] = ledger.window()
     if extra:
         report.update(extra)
     path = report_path(params, out_dir)

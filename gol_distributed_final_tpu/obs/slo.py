@@ -147,20 +147,39 @@ class IncreaseRule(Rule):
     """Fires while the window holds a counter increase above
     ``threshold`` (default: ANY increase — worker losses, integrity
     failures). The alert self-clears once the increase ages out of the
-    window."""
+    window.
+
+    ``labels`` optionally restricts the count to specific label-value
+    tuples of the family, summed — the canary-failure rule watches only
+    ``gol_canary_probes_total``'s failure results, never the ``ok``
+    stream that moves on every healthy probe."""
 
     def __init__(self, name, severity, metric, *, threshold=0.0,
-                 window_s=60.0):
+                 window_s=60.0, labels=None):
         super().__init__(name, severity)
         self.metric = metric
         self.threshold = threshold
         self.window_s = window_s
+        self.labels = [tuple(l) for l in labels] if labels else None
 
     def evaluate(self, tl):
-        inc = tl.increase(self.metric, self.window_s)
+        if self.labels is None:
+            inc = tl.increase(self.metric, self.window_s)
+        else:
+            seen = [
+                v
+                for l in self.labels
+                for v in (tl.increase(self.metric, self.window_s, labels=l),)
+                if v is not None
+            ]
+            inc = sum(seen) if seen else None
+        where = (
+            "{" + "|".join(",".join(l) for l in self.labels) + "}"
+            if self.labels else ""
+        )
         firing = inc is not None and inc > self.threshold
         return firing, inc, (
-            f"{self.metric} +{0 if inc is None else int(inc)} over "
+            f"{self.metric}{where} +{0 if inc is None else int(inc)} over "
             f"{int(self.window_s)}s (> {int(self.threshold)})"
         )
 
@@ -243,6 +262,15 @@ def default_rules() -> List[Rule]:
             "integrity-failures", "page", "gol_integrity_failures_total",
             window_s=120.0,
         ),
+        # the blackbox closure (obs/canary.py): a probe that came back
+        # WRONG ('corrupt') or failed loudly ('error') means the serving
+        # path itself is broken end to end — page within one probe
+        # period instead of waiting for a user to notice. The 'ok'
+        # stream is excluded: a healthy canary must never arm the rule.
+        IncreaseRule(
+            "canary-failure", "page", "gol_canary_probes_total",
+            window_s=120.0, labels=[("corrupt",), ("error",)],
+        ),
         # 99.9% availability objective at 14.4x burn (the SRE workbook's
         # fast-burn page): >1.44% of RPCs erroring in both windows
         BurnRateRule(
@@ -290,6 +318,7 @@ def default_rules() -> List[Rule]:
 DEFAULT_RULE_NAMES = (
     "worker-lost",
     "integrity-failures",
+    "canary-failure",
     "rpc-error-ratio",
     "session-turn-latency",
     "session-admit-latency",

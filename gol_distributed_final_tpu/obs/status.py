@@ -17,6 +17,17 @@ import sys
 from typing import Dict, Optional
 
 
+def norm_address(address: str) -> str:
+    """Accept ``tcp://host:port``, ``host:port``, and ``:port`` — the one
+    normalization every Status-surface CLI shares (doctor, canary,
+    loadgen, watch, this module)."""
+    if address.startswith("tcp://"):
+        address = address[len("tcp://"):]
+    if address.startswith(":"):
+        address = "127.0.0.1" + address
+    return address
+
+
 def series_map(snap: dict, name: str) -> Dict[tuple, dict]:
     """``{labels_tuple: series_dict}`` for one family of a registry
     snapshot — the skew-safe reader every Status consumer (obs/watch.py
@@ -83,8 +94,7 @@ def fetch_status(
     from ..rpc.client import RpcClient
     from ..rpc.protocol import Methods, Request
 
-    if address.startswith(":"):
-        address = "127.0.0.1" + address
+    address = norm_address(address)
     client = RpcClient(address, timeout=timeout)
     try:
         # timeout bounds the REPLY wait too, not just the connect: a

@@ -250,6 +250,48 @@ def _session_lines(snap: dict) -> List[str]:
     return out
 
 
+def _tenant_lines(payload: dict, top: int = 8) -> List[str]:
+    """The usage-accounting column (obs/accounting.py TenantLedger,
+    shipped as the Status ``accounting`` payload): who is spending this
+    broker's capacity — device-seconds, universe-turns, board bytes,
+    rejects, and errors per tenant (top-K + the ``other`` overflow
+    bucket), with the aggregate row last. Brokers that never served a
+    session render nothing."""
+    acct = payload.get("accounting") or {}
+    tenants = acct.get("tenants") or []
+    other = acct.get("other")
+    totals = acct.get("totals") or {}
+    if not tenants and not other:
+        return []
+    out = [
+        f"TENANTS (usage, top-{acct.get('top_k', '?')})"
+        f"{'':<10} dev-s      turns      bytes  rej  err"
+    ]
+
+    def row(e: dict, name: str) -> str:
+        return (
+            f"  {name:<22} {e.get('device_seconds') or 0.0:>9.3f} "
+            f"{int(e.get('turns') or 0):>10,} "
+            f"{_human_bytes(e.get('wire_bytes')):>10} "
+            f"{int(e.get('rejects_total') or 0):>4} "
+            f"{int(e.get('errors') or 0):>4}"
+        )
+
+    for e in tenants[:top]:
+        out.append(row(e, str(e.get("tenant", "?"))))
+    if len(tenants) > top:
+        out.append(f"  ... {len(tenants) - top} more tracked tenant(s)")
+    if other:
+        out.append(row(
+            other,
+            f"other({other.get('distinct_tenants', '?')} tenants)",
+        ))
+    if totals:
+        out.append(row(dict(totals, rejects_total=totals.get("rejects")),
+                       "TOTAL"))
+    return out
+
+
 def _worker_lines(payload: dict) -> List[str]:
     """The broker's roster health column (WorkersBackend.worker_health)
     plus the fault-tolerance counters: who is connected, who is lost and
@@ -410,6 +452,7 @@ def render_status(
         _rpc_lines(snap),
         _wire_lines(snap),
         _session_lines(snap),
+        _tenant_lines(payload),
         _integrity_lines(snap),
         _worker_lines(payload),
         _compile_lines(snap),

@@ -1434,7 +1434,12 @@ class SessionScheduler:
     A nonzero client-chosen ``Request.session_id`` tags the session so a
     concurrent Retrieve with the same tag serves THAT universe's
     per-session snapshot — the AliveCellsCount ticker contract, per
-    universe."""
+    universe. A tag whose session COMPLETED keeps serving its final
+    snapshot from a bounded cache (``_FINISHED_CAP`` most-recent tagged
+    sessions, FIFO-evicted) — the engine's retrieve-after-run contract,
+    per universe. Without it every poller trailing a fast universe eats
+    an error reply, and a blameless canary/loadgen poll stream would
+    burn the rpc-error-ratio budget of the very SLO it measures."""
 
     # scheduler state moves under ONE lock, entered either directly or
     # through the _work Condition wrapping it (analysis/locks.py
@@ -1442,19 +1447,33 @@ class SessionScheduler:
     _GUARDED_BY = {
         "_table": ("_lock", "_work"),
         "_tags": ("_lock", "_work"),
+        "_finished": ("_lock", "_work"),
+        "_finished_bytes": ("_lock", "_work"),
         "_stop": ("_lock", "_work"),
         "_thread": ("_lock", "_work"),
     }
 
+    #: completed tagged sessions whose final snapshot stays retrievable —
+    #: bounded BOTH ways: entry count AND retained board bytes (each
+    #: entry pins a full final board; 1024 x a 2048^2 geometry would be
+    #: gigabytes under a count bound alone)
+    _FINISHED_CAP = 1024
+    _FINISHED_BYTES_CAP = 64 << 20  # 64 MiB of retained final boards
+
     def __init__(self, capacity: int = 256, max_chunk: int = 4096):
         if capacity < 1:
             raise ValueError(f"session capacity must be >= 1, got {capacity}")
+        import collections
+
         self.capacity = capacity
         self.max_chunk = max_chunk
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
         self._table = None  # current SessionTable (one geometry/rule)
         self._tags: dict[int, object] = {}  # session_id -> Session
+        # session_id -> completed Session (bounded, insertion-ordered)
+        self._finished = collections.OrderedDict()
+        self._finished_bytes = 0  # result bytes the cache currently pins
         self._thread: threading.Thread | None = None
         self._stop = False
 
@@ -1469,71 +1488,125 @@ class SessionScheduler:
     def submit(self, req: Request) -> RunResult:
         """Blocking: admit this Run into the batch, wait for its universe
         to finish, return its result. Raises ``SessionRejected`` on
-        admission refusal (error reply to the client)."""
-        from ..engine.sessions import SessionTable, reject
+        admission refusal (error reply to the client).
+
+        Every outcome attributes to the caller's TENANT (the high bits
+        of the client-chosen ``session_id`` tag — obs/accounting.py):
+        admission waits and board bytes on admit, the reject REASON on
+        refusal (so a noisy tenant's capacity rejects are
+        distinguishable from global overload), errors on a failed batch
+        — the bounded per-tenant ledger the Status ``accounting``
+        payload, the TENANTS watch panel, and the doctor's hot-tenant
+        finding all read."""
+        from ..engine.sessions import SessionRejected, SessionTable, reject
+        from ..obs import accounting as _acct
 
         rule = self._rule_for(req)
         shape = (req.image_height, req.image_width)
         world = np.asarray(req.world, np.uint8)
         tag = getattr(req, "session_id", 0)
+        tenant = _acct.tenant_of(tag)
+        ledger = _acct.ledger()
         # admission latency (entry to the session joining the table) —
         # the 'session-admit-latency' SLO feed: growth means the table
         # lock is contended or a rejected storm is thrashing it
         t_admit = time.monotonic()
-        with self._work:
-            if self._stop:
-                raise RpcError("broker is shutting down")
-            if self._table is not None and self._table.occupancy == 0 and (
-                self._table.shape != shape
-                or self._table.rule.rulestring != rule.rulestring
-            ):
-                # drained: the next admission may claim a new geometry
-                self._table = None
-            if self._table is None:
-                self._table = SessionTable(
-                    rule, shape, self.capacity, max_chunk=self.max_chunk
-                )
-            if self._table.rule.rulestring != rule.rulestring:
-                raise reject(
-                    "rule",
-                    f"this batch serves {self._table.rule.rulestring}, "
-                    f"not {rule.rulestring} (one rule per batch)",
-                )
-            if tag and tag in self._tags:
-                raise reject("tag", f"session tag {tag} already in use")
-            # geometry/capacity/turns admission happens in the table
-            sess = self._table.admit(world, req.turns)
-            if tag:
-                self._tags[tag] = sess
-            if self._thread is None:
-                self._thread = threading.Thread(
-                    target=self._drive, daemon=True
-                )
-                self._thread.start()
-            self._work.notify_all()
-            _ins.SESSION_ADMIT_WAIT_SECONDS.observe(
-                time.monotonic() - t_admit
-            )
+        try:
+            with self._work:
+                if self._stop:
+                    raise RpcError("broker is shutting down")
+                if self._table is not None and self._table.occupancy == 0 and (
+                    self._table.shape != shape
+                    or self._table.rule.rulestring != rule.rulestring
+                ):
+                    # drained: the next admission may claim a new geometry
+                    self._table = None
+                if self._table is None:
+                    self._table = SessionTable(
+                        rule, shape, self.capacity, max_chunk=self.max_chunk
+                    )
+                if self._table.rule.rulestring != rule.rulestring:
+                    raise reject(
+                        "rule",
+                        f"this batch serves {self._table.rule.rulestring}, "
+                        f"not {rule.rulestring} (one rule per batch)",
+                    )
+                if tag and tag in self._tags:
+                    raise reject("tag", f"session tag {tag} already in use")
+                # geometry/capacity/turns admission happens in the table
+                sess = self._table.admit(world, req.turns, tenant=tenant)
+                if tag:
+                    self._tags[tag] = sess
+                    # a reused tag belongs to its NEW session now
+                    old = self._finished.pop(tag, None)
+                    if old is not None and old.result is not None:
+                        self._finished_bytes -= old.result.nbytes
+                if self._thread is None:
+                    self._thread = threading.Thread(
+                        target=self._drive, daemon=True
+                    )
+                    self._thread.start()
+                self._work.notify_all()
+                wait = time.monotonic() - t_admit
+                _ins.SESSION_ADMIT_WAIT_SECONDS.observe(wait)
+                ledger.record_admit(tenant, wait, world.nbytes)
+        except SessionRejected as exc:
+            # the per-tenant attribution behind the anonymous
+            # gol_sessions_rejected_total{reason} pool (the counter
+            # itself already metered inside reject())
+            ledger.record_reject(tenant, exc.reason)
+            raise
         try:
             sess.done.wait()
         finally:
             with self._lock:
                 if tag and self._tags.get(tag) is sess:
                     del self._tags[tag]
+                    if sess.error is None and sess.result is not None:
+                        # the final snapshot stays retrievable: a poller
+                        # trailing a fast universe gets the final (board,
+                        # turn, alive) instead of an error reply. HEALTHY
+                        # completions only — a failed or cancelled
+                        # session must stay a loud retrieve error, never
+                        # a healthy-looking partial snapshot
+                        self._finished[tag] = sess
+                        self._finished_bytes += sess.result.nbytes
+                        while self._finished and (
+                            len(self._finished) > self._FINISHED_CAP
+                            or self._finished_bytes
+                            > self._FINISHED_BYTES_CAP
+                        ):
+                            _, old = self._finished.popitem(last=False)
+                            if old.result is not None:
+                                self._finished_bytes -= old.result.nbytes
         if sess.error is not None:
+            ledger.record_error(tenant)  # the tenant's SLO-burn share
             raise RpcError(f"session batch failed: {sess.error}")
+        if sess.result is not None:
+            ledger.record_reply_bytes(tenant, sess.result.nbytes)
         return RunResult(sess.turns_done, sess.result)
 
     def retrieve(self, tag: int, include_world: bool) -> Snapshot:
         """The per-session Retrieve surface: the (turn, alive) pair — and
-        optionally the board — of ONE universe, demuxed from the batch."""
+        optionally the board — of ONE universe, demuxed from the batch.
+        A COMPLETED tag serves its final snapshot from the bounded
+        finished cache; a tag never seen (or evicted) is still a loud
+        error, never a silent global snapshot."""
         with self._lock:
             sess = self._tags.get(tag)
             table = self._table
-        if sess is None or table is None:
-            raise RpcError(f"no session with tag {tag}")
-        world, turn, alive = table.snapshot(sess, include_world=include_world)
-        return Snapshot(world, turn, alive)
+            done = self._finished.get(tag)
+        if sess is not None and table is not None:
+            world, turn, alive = table.snapshot(
+                sess, include_world=include_world
+            )
+            return Snapshot(world, turn, alive)
+        if done is not None:
+            return Snapshot(
+                done.result if include_world else None,
+                done.turns_done, done.alive_count,
+            )
+        raise RpcError(f"no session with tag {tag}")
 
     def _drive(self) -> None:
         """The driver thread: advance the batch whenever it has work; on
@@ -1754,9 +1827,13 @@ class BrokerService:
         from ..obs.report import status_payload
 
         since = getattr(req, "timeline_since", 0)
+        # accounting_since: the tenant-ledger twin of timeline_since
+        # (getattr: an older client's pickle lacks it; 0 = full ledger)
+        asince = getattr(req, "accounting_since", 0)
         payload = status_payload(
             role="broker", backend=type(self.backend).__name__,
             timeline_since=since if isinstance(since, int) else 0,
+            accounting_since=asince if isinstance(asince, int) else 0,
         )
         health = getattr(self.backend, "worker_health", None)
         if callable(health):
@@ -1967,6 +2044,24 @@ def main(argv=None) -> None:
              "obs/flight.py): spans join the calling controller's trace "
              "via Request.trace_ctx and ship back in Status replies",
     )
+    parser.add_argument(
+        "-canary", nargs="?", const=5.0, default=None, type=float,
+        metavar="SECS",
+        help="run the blackbox canary prober (obs/canary.py) in-process "
+             "against this broker's own port at this cadence (default "
+             "5 s): a known-oracle universe through the full RPC + "
+             "session path every period, bit-exact or metered as a "
+             "failure (pair with -timeline so the 'canary-failure' SLO "
+             "rule pages); implies -metrics",
+    )
+    parser.add_argument(
+        "-canary-verb", dest="canary_verb", choices=("session", "run"),
+        default="session",
+        help="-canary probe path: SessionRun + tagged retrieve (default; "
+             "safe beside live traffic) or the classic blocking Run — "
+             "exercises the backend data plane itself (workers scatter / "
+             "resident strips), but collides with real single-board Runs",
+    )
     args = parser.parse_args(argv)
     logging.basicConfig(
         level=logging.INFO,
@@ -2069,6 +2164,8 @@ def main(argv=None) -> None:
                 args.resume, gen, turn,
             )
         resume = (board, turn, rule)
+    if args.canary is not None and args.canary <= 0:
+        parser.error(f"-canary SECS must be > 0, got {args.canary}")
     addresses = [a for a in args.workers.split(",") if a]
     server, service = serve(
         args.port, args.backend, addresses, host=args.host, wire=args.wire,
@@ -2082,7 +2179,30 @@ def main(argv=None) -> None:
         session_capacity=args.session_capacity,
     )
     print(f"broker listening on :{server.port} (backend={args.backend})", flush=True)
-    service.quit_event.wait()
+    canary = None
+    if args.canary is not None:
+        # after serve(): the prober dials the BOUND port over a real
+        # socket — the full client path, not an in-process shortcut.
+        # Dial the bound interface: a broker on -host 10.0.0.5 does not
+        # listen on loopback, and a canary refused every period would
+        # page 'canary-failure' on a healthy path forever
+        from ..obs import metrics
+        from ..obs.canary import CanaryProber
+
+        metrics.enable()  # the probe counters must record
+        probe_host = (
+            "127.0.0.1" if args.host in ("0.0.0.0", "::") else args.host
+        )
+        canary = CanaryProber(
+            f"{probe_host}:{server.port}", period=args.canary,
+            verb=args.canary_verb,
+        )
+        canary.start()
+    try:
+        service.quit_event.wait()
+    finally:
+        if canary is not None:
+            canary.stop()
 
 
 if __name__ == "__main__":

@@ -35,14 +35,21 @@ class RpcError(Exception):
     ``is_reply`` distinguishes an error the SERVER sent (a completed
     round-trip — the peer is alive) from a transport-level failure raised
     client-side (timeout, closed connection, failed send/reconnect): the
-    broker's readmission probe treats the former as proof of life."""
+    broker's readmission probe treats the former as proof of life.
+
+    ``reason`` is the machine-readable refusal reason when the remote
+    exception carried one (``SessionRejected.reason`` — the
+    ``gol_sessions_rejected_total`` label): callers classify an
+    admission refusal structurally (obs/loadgen.py does) instead of
+    string-matching the message. None against an older server."""
 
     is_reply = False
 
-    def __init__(self, message, kind=None, remote_traceback=None):
+    def __init__(self, message, kind=None, remote_traceback=None, reason=None):
         super().__init__(message)
         self.kind = kind
         self.remote_traceback = remote_traceback
+        self.reason = reason
 
 
 _RECONNECT_BACKOFF0 = 0.2  # first retry delay; doubles per failure
@@ -365,6 +372,7 @@ class RpcClient:
                 reply["error"],
                 kind=reply.get("error_kind"),
                 remote_traceback=reply.get("error_traceback"),
+                reason=reply.get("error_reason"),
             )
             err.is_reply = True  # a reply arrived: the peer is alive
             raise err
@@ -460,7 +468,13 @@ class RemoteBroker:
         (each on its own connection/thread); a nonzero ``session_id``
         tags the session so ``retrieve(session_id=...)`` serves its
         per-universe ticker snapshot mid-flight. Admission refusals
-        (capacity / geometry / rule / tag) surface as RpcError replies."""
+        (capacity / geometry / rule / tag) surface as RpcError replies
+        with ``kind == "SessionRejected"`` and the STRUCTURED refusal
+        reason on ``RpcError.reason`` (skew-safe: None from an older
+        server) — classify on that, never on the message text. A
+        tenant-packed tag (obs/accounting.make_tag: tenant id in the
+        high 32 bits) attributes this session's usage in the broker's
+        accounting ledger."""
         req = Request(
             world=world,
             turns=params.turns,

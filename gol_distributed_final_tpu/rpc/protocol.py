@@ -119,6 +119,12 @@ class Request:
     # version-skewed older client's pickle lacks it and 0 means "the
     # full ring", exactly like the other extension defaults.
     timeline_since: int = 0
+    # extension: incremental tenant-accounting windows (obs/accounting.py)
+    # — timeline_since's twin for the per-tenant usage ledger: a Status
+    # caller echoes the last ledger ``seq`` it received and the server
+    # ships only tenants that changed since (totals always ride). Same
+    # skew posture: getattr, absent/0 = the full (bounded) ledger.
+    accounting_since: int = 0
 
 
 @dataclasses.dataclass

@@ -182,6 +182,14 @@ class RpcServer:
                             -_TRACEBACK_LIMIT:
                         ],
                     }
+                    # machine-readable refusal reason (SessionRejected's
+                    # REJECT_REASONS label): clients classify rejects
+                    # without string-matching the message. Skew-safe like
+                    # error_kind — an old client ignores the key, an old
+                    # server's reply simply lacks it (dict.get)
+                    reason = getattr(e, "reason", None)
+                    if isinstance(reason, str):
+                        reply["error_reason"] = reason
                     _flight.record(
                         "rpc.error", verb, error_kind=type(e).__name__,
                         message=str(e)[:200],

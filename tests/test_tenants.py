@@ -1,0 +1,585 @@
+"""Tenant-scoped serving observability (obs/accounting.py, obs/canary.py,
+obs/loadgen.py): ledger math + bounded cardinality, chunk-boundary
+attribution reconciling exactly with the session meters, structured
+reject reasons on the client exception, incremental + version-skew-safe
+Status accounting windows under the documented size budget, the canary's
+bit-exact probe (and its detection of an injected wrong-board fault),
+the canary-failure SLO rule, the open-loop load generator, the watch
+TENANTS panel, and the doctor's tenant-skew finding.
+"""
+
+import pickle
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gol_distributed_final_tpu.obs import accounting as obs_accounting
+from gol_distributed_final_tpu.obs import metrics as obs_metrics
+from gol_distributed_final_tpu.obs import timeline as obs_timeline
+from gol_distributed_final_tpu.obs.accounting import (
+    TenantLedger,
+    make_tag,
+    tenant_of,
+)
+
+
+@pytest.fixture
+def live_metrics():
+    """Enable the registry + zero the global ledger for one test (the
+    test_slo.py posture, extended to the accounting global)."""
+    reg = obs_metrics.registry()
+    reg.reset()
+    obs_accounting.ledger().reset()
+    obs_metrics.enable()
+    yield reg
+    obs_metrics.enable(False)
+    reg.reset()
+    obs_accounting.ledger().reset()
+
+
+# -- the tag convention ------------------------------------------------------
+
+
+def test_tenant_of_convention():
+    # high 32 bits = tenant; low bits = nonce
+    assert tenant_of(make_tag(7, 123)) == "7"
+    assert tenant_of(make_tag(7, 999)) == "7"
+    # a zero nonce is forced nonzero so the tag never collapses to 0
+    assert make_tag(7, 0) != 7 << 32
+    assert tenant_of(make_tag(7, 0)) == "7"
+    # a pre-convention small tag is its own tenant
+    assert tenant_of(42) == "42"
+    # untagged / invalid degrade to the "-" tenant, never raise
+    assert tenant_of(0) == "-"
+    assert tenant_of(None) == "-"
+    assert tenant_of(-3) == "-"
+
+
+# -- ledger math -------------------------------------------------------------
+
+
+def test_ledger_records_and_totals(live_metrics):
+    led = TenantLedger(top_k=4)
+    led.record_admit("a", 0.5, 100)
+    led.record_chunk(["a", "a", "b"], 4, 0.9)  # 0.3 s + 4 turns each
+    led.record_reject("b", "capacity")
+    led.record_reject("b", "capacity")
+    led.record_error("a")
+    led.record_reply_bytes("a", 50)
+    win = led.window()
+    by = {e["tenant"]: e for e in win["tenants"]}
+    assert by["a"]["sessions"] == 1
+    assert by["a"]["wire_bytes"] == 150
+    assert by["a"]["turns"] == 8
+    assert by["a"]["device_seconds"] == pytest.approx(0.6)
+    assert by["a"]["errors"] == 1
+    assert by["b"]["rejects"] == {"capacity": 2}
+    assert by["b"]["rejects_total"] == 2
+    totals = win["totals"]
+    assert totals["turns"] == 12
+    assert totals["device_seconds"] == pytest.approx(0.9)
+    assert totals["rejects"] == 2 and totals["errors"] == 1
+    # sorted by device-seconds descending
+    assert win["tenants"][0]["tenant"] == "a"
+
+
+def test_ledger_disabled_registry_is_noop():
+    obs_metrics.enable(False)
+    led = TenantLedger()
+    led.record_admit("a", 0.1, 10)
+    led.record_chunk(["a"], 1, 0.1)
+    assert not led.has_data
+    assert led.window()["tenants"] == []
+
+
+def test_ledger_bounded_cardinality(live_metrics):
+    """A tag flood must not grow memory: top_k tracked, the rest fold
+    into ONE 'other' bucket whose aggregates keep the totals exact."""
+    led = TenantLedger(top_k=8)
+    for i in range(50):
+        led.record_admit(f"t{i}", 0.0, 1)
+    win = led.window()
+    assert win["tracked"] == 8 and len(win["tenants"]) == 8
+    other = win["other"]
+    assert other["sessions"] == 42
+    assert other["distinct_tenants"] == 42
+    assert win["totals"]["sessions"] == 50
+    assert win["totals"]["wire_bytes"] == 50
+    # distinct counts TENANTS, not records: one noisy overflow tenant
+    # hammering the ledger must still read as ONE tenant
+    for _ in range(30):
+        led.record_admit("t49", 0.0, 1)
+        led.record_chunk(["t49"], 1, 0.001)
+    assert led.window()["other"]["distinct_tenants"] == 42
+    # ...and the distinct set is itself bounded (8 x top_k): a tag flood
+    # saturates the reading instead of growing memory
+    for i in range(5000):
+        led.record_admit(f"flood{i}", 0.0, 1)
+    assert led.window()["other"]["distinct_tenants"] == 8 * 8
+    assert led.window()["totals"]["sessions"] == 50 + 30 + 5000
+
+
+def test_ledger_incremental_window(live_metrics):
+    led = TenantLedger()
+    led.record_admit("a", 0.0, 1)
+    seq1 = led.seq
+    led.record_admit("b", 0.0, 1)
+    win = led.window(since=seq1)
+    names = [e["tenant"] for e in win["tenants"]]
+    assert names == ["b"]  # only the tenant that changed since seq1
+    assert win["totals"]["sessions"] == 2  # totals always ride
+    assert led.window(since=led.seq)["tenants"] == []
+
+
+# -- chunk-boundary attribution (engine/sessions.py) -------------------------
+
+
+def test_session_table_attributes_chunks(live_metrics):
+    """The ledger's device-seconds/turns must reconcile EXACTLY with
+    gol_session_turn_seconds' sum and gol_session_turns_total — same
+    chunk walls, split per tenant."""
+    from gol_distributed_final_tpu.engine.sessions import SessionTable
+    from gol_distributed_final_tpu.obs.status import scalar_value, series_map
+
+    rng = np.random.default_rng(0)
+    table = SessionTable(shape=(16, 16), capacity=8)
+    boards = [
+        np.where(rng.random((16, 16)) < 0.3, 255, 0).astype(np.uint8)
+        for _ in range(4)
+    ]
+    for i, b in enumerate(boards):
+        table.admit(b, 12, tenant=f"t{i % 2}")
+    while table.advance():
+        pass
+    snap = obs_metrics.registry().snapshot()
+    win = obs_accounting.ledger().window()
+    totals = win["totals"]
+    assert totals["turns"] == int(
+        scalar_value(snap, "gol_session_turns_total")
+    ) == 4 * 12
+    hist = series_map(snap, "gol_session_turn_seconds").get(())
+    # abs tolerance = the window's round(…, 6) quantum
+    assert totals["device_seconds"] == pytest.approx(
+        hist["sum"], rel=1e-6, abs=1e-6
+    )
+    by = {e["tenant"]: e for e in win["tenants"]}
+    assert by["t0"]["turns"] == by["t1"]["turns"] == 24
+
+
+# -- the serving surface (scheduler + structured rejects) --------------------
+
+
+def _serve_loopback(**kw):
+    from gol_distributed_final_tpu.rpc.broker import serve
+
+    server, service = serve(port=0, **kw)
+    return server, service, f"127.0.0.1:{server.port}"
+
+
+def test_scheduler_attribution_and_reject_reason(live_metrics):
+    """Live loopback: tenant-packed SessionRuns attribute per tenant;
+    a capacity refusal reaches the client as RpcError with
+    kind == 'SessionRejected' AND the STRUCTURED reason (no string
+    matching) — and the ledger books the reject to the tenant."""
+    from gol_distributed_final_tpu.params import Params
+    from gol_distributed_final_tpu.rpc.client import RemoteBroker, RpcError
+
+    server, service, addr = _serve_loopback(session_capacity=2)
+    rng = np.random.default_rng(1)
+    board = np.where(rng.random((16, 16)) < 0.3, 255, 0).astype(np.uint8)
+    params = Params(turns=400, image_width=16, image_height=16, threads=1)
+    try:
+        brokers = [RemoteBroker(addr, timeout=30.0) for _ in range(3)]
+        results, errors = [], []
+
+        def run(i):
+            try:
+                results.append(
+                    brokers[i].session_run(
+                        params, board, session_id=make_tag(10 + i, i + 1)
+                    )
+                )
+            except RpcError as exc:
+                errors.append(exc)
+
+        # fill the two capacity slots first so the third is refused
+        threads = []
+        for i in range(2):
+            t = threading.Thread(target=run, args=(i,), daemon=True)
+            t.start()
+            threads.append(t)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            active = obs_metrics.registry().gauge("gol_sessions_active").value
+            if active >= 2:
+                break
+            time.sleep(0.01)
+        run(2)  # over capacity: refused synchronously
+        for t in threads:
+            t.join(timeout=60.0)
+        assert len(results) == 2 and len(errors) == 1
+        err = errors[0]
+        assert err.kind == "SessionRejected"
+        assert err.reason == "capacity"  # the structured reject reason
+        # a COMPLETED tag keeps serving its final snapshot (the bounded
+        # finished cache) — a trailing poller never eats an error reply
+        snap = brokers[0].retrieve(session_id=make_tag(10, 1))
+        assert snap.turns_completed == 400
+        assert snap.world is not None and snap.world.shape == (16, 16)
+        # ...but a tag never admitted is still a loud error
+        with pytest.raises(RpcError, match="no session"):
+            brokers[0].retrieve(session_id=999999)
+        win = obs_accounting.ledger().window()
+        by = {e["tenant"]: e for e in win["tenants"]}
+        assert by["12"]["rejects"] == {"capacity": 1}
+        assert by["10"]["sessions"] == 1 and by["11"]["sessions"] == 1
+        assert by["10"]["turns"] == 400
+        # board bytes both ways: 256 in + 256 out
+        assert by["10"]["wire_bytes"] == 512
+        for b in brokers:
+            b.close()
+    finally:
+        service._shutdown()
+
+
+# -- Status payload: incremental, skew-safe, size-budgeted -------------------
+
+
+def test_status_accounting_window_and_skew(live_metrics):
+    from gol_distributed_final_tpu.rpc.client import RpcClient
+    from gol_distributed_final_tpu.rpc.protocol import Methods, Request
+
+    led = obs_accounting.ledger()
+    led.record_admit("7", 0.01, 64)
+    seq = led.seq
+    led.record_admit("8", 0.01, 64)
+    server, service, addr = _serve_loopback()
+    client = RpcClient(addr)
+    try:
+        res = client.call(
+            Methods.STATUS, Request(accounting_since=seq)
+        )
+        acct = res.status["accounting"]
+        assert [e["tenant"] for e in acct["tenants"]] == ["8"]
+        assert acct["totals"]["sessions"] == 2
+        # a version-skewed client whose pickle predates accounting_since
+        # gets the FULL ledger, never an AttributeError reply
+        old = Request()
+        del old.__dict__["accounting_since"]
+        res = client.call(Methods.STATUS, old)
+        assert len(res.status["accounting"]["tenants"]) == 2
+        # hostile non-int degrades to the full window, not a crash
+        bad = Request()
+        bad.accounting_since = "not-a-seq"
+        res = client.call(Methods.STATUS, bad)
+        assert len(res.status["accounting"]["tenants"]) == 2
+    finally:
+        client.close()
+        service._shutdown()
+
+
+def test_status_payload_size_budget(live_metrics):
+    """The documented budget (README "Accounting & capacity"): an
+    INCREMENTAL Status reply — timeline echo + alerts + accounting at
+    top-K=16 tenants — stays under 64 KiB."""
+    from gol_distributed_final_tpu.obs.report import status_payload
+    from gol_distributed_final_tpu.rpc.protocol import Response
+
+    led = obs_accounting.ledger()
+    assert led.top_k == 16
+    tl = obs_timeline.enable(period=60.0, start_thread=False)
+    try:
+        for i in range(40):  # 16 tracked + a busy 'other' bucket
+            t = str(1000 + i)
+            led.record_admit(t, 0.001, 4096)
+            led.record_chunk([t] * 3, 32, 0.05)
+            led.record_reject(t, "capacity")
+        for _ in range(5):
+            obs_metrics.registry().counter("gol_engine_turns_total").inc(7)
+            tl.sample_once()
+        seq = tl.seq
+        obs_metrics.registry().counter("gol_engine_turns_total").inc()
+        tl.sample_once()
+        payload = status_payload(
+            role="broker", timeline_since=seq, accounting_since=0
+        )
+        assert payload["accounting"]["tenants"] and payload["alerts"]
+        nbytes = len(pickle.dumps(Response(status=payload), protocol=5))
+        assert nbytes < 65536, f"incremental Status reply is {nbytes} B"
+    finally:
+        obs_timeline.disable()
+
+
+# -- canary ------------------------------------------------------------------
+
+
+def test_canary_probe_bit_exact(live_metrics):
+    from gol_distributed_final_tpu.obs.canary import CanaryProber
+    from gol_distributed_final_tpu.obs.status import series_map
+
+    server, service, addr = _serve_loopback()
+    prober = CanaryProber(addr, size=16, turns=16, verb="session")
+    try:
+        out = prober.probe_once()
+        assert out["result"] == "ok", out
+        snap = obs_metrics.registry().snapshot()
+        probes = series_map(snap, "gol_canary_probes_total")
+        assert (probes.get(("ok",)) or {}).get("value") == 1
+        lat = series_map(snap, "gol_canary_latency_seconds").get(())
+        assert lat and lat["count"] == 1
+        # the canary's usage is ledger-attributed under its tenant
+        by = {
+            e["tenant"]: e
+            for e in obs_accounting.ledger().window()["tenants"]
+        }
+        assert str(0xCA) in by and by[str(0xCA)]["turns"] == 16
+    finally:
+        prober.stop()
+        service._shutdown()
+
+
+def test_canary_detects_injected_wrong_board(live_metrics):
+    """The acceptance scenario: a resident-strip worker corrupted in
+    place (GOL_FAULT_POINTS strip corrupt) with -integrity off — the
+    white-box defenses are disabled by design, so the serving path
+    returns a silently-wrong board, and the BLACKBOX canary is what
+    catches it, within one probe."""
+    from gol_distributed_final_tpu.obs.canary import (
+        CanaryProber,
+        _oracle_evolve,
+        canary_board,
+    )
+    from gol_distributed_final_tpu.obs.status import series_map
+    from gol_distributed_final_tpu.rpc import faults as rpc_faults
+    from gol_distributed_final_tpu.rpc import integrity as rpc_integrity
+    from gol_distributed_final_tpu.rpc import worker as rpc_worker
+    from gol_distributed_final_tpu.rpc.broker import serve
+
+    # pick a flip index whose corruption provably survives to the final
+    # board (a flip in a dead neighborhood just dies out — that WOULD be
+    # served correctly, and correctly is not what this test injects)
+    board = canary_board(16, 0, 1)
+    want, _ = _oracle_evolve(board, 16)
+
+    def flip_matters(i: int) -> bool:
+        flipped = board.copy()
+        flipped.reshape(-1)[i] ^= 0xFF
+        return not np.array_equal(_oracle_evolve(flipped, 16)[0], want)
+
+    idx = next(i for i in range(board.size) if flip_matters(i))
+
+    wserver, _wservice = rpc_worker.serve(port=0)
+    server, service = serve(
+        port=0, backend="workers",
+        worker_addresses=[f"127.0.0.1:{wserver.port}"], wire="resident",
+    )
+    rpc_integrity.set_enabled(False)  # undefended by design
+    rpc_faults.configure(f"worker.strip_corrupt:corrupt:1:{idx}")
+    prober = CanaryProber(
+        f"127.0.0.1:{server.port}", size=16, turns=16, verb="run"
+    )
+    # rules=[]: the rule is evaluated EXPLICITLY below — a metering
+    # rulebook here would leave a canary-failure label child behind for
+    # test_slo's exact-series assertions (registry reset keeps children)
+    tl = obs_timeline.enable(period=60.0, start_thread=False, rules=[])
+    try:
+        tl.sample_once()  # the pre-probe baseline tick
+        out = prober.probe_once()
+        assert out["result"] == "corrupt", out
+        assert "diverges from the oracle" in out["detail"] or "alive" in out["detail"]
+        snap = obs_metrics.registry().snapshot()
+        probes = series_map(snap, "gol_canary_probes_total")
+        assert (probes.get(("corrupt",)) or {}).get("value") == 1
+        # ...and the canary-failure SLO rule FIRES on the very next tick
+        # — within one probe period, the acceptance contract
+        tl.sample_once()
+        from gol_distributed_final_tpu.obs import slo
+
+        rule = next(
+            r for r in slo.default_rules() if r.name == "canary-failure"
+        )
+        firing, value, detail = rule.evaluate(tl)
+        assert firing and value == 1, detail
+    finally:
+        obs_timeline.disable()
+        rpc_faults.configure(None)
+        rpc_integrity.set_enabled(True)
+        prober.stop()
+        service._shutdown()
+        wserver.stop()
+
+
+def test_canary_failure_rule_fires_on_failures_only(live_metrics):
+    """The canary-failure SLO rule watches ONLY the corrupt/error result
+    streams: a healthy probing stream must never arm it."""
+    from gol_distributed_final_tpu.obs import slo
+
+    # rules=[] so the rule only evaluates where this test calls it (a
+    # metering rulebook would leak a label child into later exact-series
+    # assertions — see the corrupt test above)
+    tl = obs_timeline.enable(period=60.0, start_thread=False, rules=[])
+    try:
+        rule = next(
+            r for r in slo.default_rules() if r.name == "canary-failure"
+        )
+        probes = obs_metrics.registry().counter(
+            "gol_canary_probes_total", labelnames=("result",)
+        )
+        tl.sample_once(now=0.0, wall=0.0)
+        probes.labels("ok").inc(10)
+        tl.sample_once(now=10.0, wall=10.0)
+        firing, _, detail = rule.evaluate(tl)
+        assert not firing, detail
+        probes.labels("corrupt").inc()
+        tl.sample_once(now=20.0, wall=20.0)
+        firing, value, detail = rule.evaluate(tl)
+        assert firing and value == 1
+        assert "corrupt" in detail
+        # and it is in the default rulebook's stable name contract
+        assert "canary-failure" in slo.DEFAULT_RULE_NAMES
+    finally:
+        obs_timeline.disable()
+
+
+# -- loadgen -----------------------------------------------------------------
+
+
+def test_loadgen_open_loop_and_reject_classification(live_metrics):
+    """A burst past -session-capacity: completions + classified rejects
+    sum to the schedule, rejects classify by the STRUCTURED reason, and
+    the client-side latency histograms record every completion."""
+    from gol_distributed_final_tpu.obs.loadgen import LoadConfig, LoadGenerator
+    from gol_distributed_final_tpu.obs.status import series_map
+
+    server, service, addr = _serve_loopback(session_capacity=2)
+    try:
+        summary = LoadGenerator(addr, LoadConfig(
+            rate=1e6, sessions=10, arrival="burst", burst=10,
+            tenants=3, size=16, turns=500, seed=5, timeout=120.0,
+        )).run()
+        assert summary["issued"] == 10
+        assert (
+            summary["completed"] + summary["rejected_total"]
+            + summary["errors"] == 10
+        )
+        assert summary["errors"] == 0
+        assert summary["rejected_total"] >= 1
+        assert set(summary["rejected"]) == {"capacity"}
+        assert summary["admit_to_first_turn"]["n"] == summary["completed"]
+        snap = obs_metrics.registry().snapshot()
+        outcomes = series_map(snap, "gol_loadgen_sessions_total")
+        assert (outcomes.get(("ok",)) or {}).get("value") == summary["completed"]
+        assert (outcomes.get(("rejected",)) or {}).get("value") == summary[
+            "rejected_total"
+        ]
+        e2e = series_map(snap, "gol_loadgen_session_seconds").get(())
+        assert e2e and e2e["count"] == summary["completed"]
+        # ledger reconciliation (the --loadgen gate's assert, in-proc)
+        totals = obs_accounting.ledger().totals()
+        assert totals["turns"] == summary["completed"] * 500
+        assert totals["rejects"] == summary["rejected_total"]
+    finally:
+        service._shutdown()
+
+
+def test_loadgen_schedule_determinism():
+    from gol_distributed_final_tpu.obs.loadgen import LoadConfig, LoadGenerator
+
+    cfg = LoadConfig(rate=100.0, sessions=20, arrival="poisson", seed=9)
+    a = LoadGenerator("127.0.0.1:1", cfg)._schedule()
+    b = LoadGenerator("127.0.0.1:1", cfg)._schedule()
+    assert a == b and len(a) == 20 and a == sorted(a)
+    burst = LoadConfig(rate=100.0, sessions=20, arrival="burst", burst=5)
+    times = LoadGenerator("127.0.0.1:1", burst)._schedule()
+    assert times[0] == times[4] and times[5] == pytest.approx(0.05)
+    with pytest.raises(ValueError):
+        LoadConfig(arrival="nope").validate()
+    with pytest.raises(ValueError):
+        LoadConfig(rate=0).validate()
+
+
+# -- watch TENANTS panel + doctor tenant skew --------------------------------
+
+
+def _acct_payload(hot_share=0.8, rejects=0):
+    tenants = [
+        {"tenant": "7", "device_seconds": hot_share * 10, "turns": 800,
+         "wire_bytes": 4096, "sessions": 8, "rejects": {"capacity": rejects},
+         "rejects_total": rejects, "errors": 0, "seq": 5},
+        {"tenant": "8", "device_seconds": (1 - hot_share) * 10, "turns": 200,
+         "wire_bytes": 1024, "sessions": 2, "rejects": {},
+         "rejects_total": 0, "errors": 0, "seq": 6},
+    ]
+    return {
+        "schema": "gol-accounting/1", "seq": 6, "top_k": 16, "tracked": 2,
+        "tenants": tenants, "other": None,
+        "totals": {"device_seconds": 10.0, "turns": 1000,
+                   "wire_bytes": 5120, "sessions": 10,
+                   "rejects": rejects, "errors": 0},
+    }
+
+
+def test_watch_tenants_panel_pure_render():
+    from gol_distributed_final_tpu.obs.watch import render_status
+
+    payload = {
+        "role": "broker", "pid": 1, "metrics_enabled": True,
+        "metrics": {"families": []},
+        "accounting": _acct_payload(),
+    }
+    out = render_status("broker :1", payload)
+    assert "TENANTS (usage, top-16)" in out
+    assert "TOTAL" in out and "  7 " in out
+    # no accounting → no panel
+    del payload["accounting"]
+    assert "TENANTS" not in render_status("broker :1", payload)
+
+
+def test_doctor_names_hot_tenant():
+    from gol_distributed_final_tpu.obs.doctor import diagnose, render
+
+    statuses = {
+        "broker 127.0.0.1:8040": {
+            "role": "broker", "pid": 1, "metrics_enabled": True,
+            "metrics": {"families": []},
+            "accounting": _acct_payload(hot_share=0.8, rejects=12),
+        }
+    }
+    findings = diagnose(statuses)
+    skew = [f for f in findings if "device-seconds" in f["title"]]
+    assert skew and "tenant 7" in skew[0]["title"]
+    assert skew[0]["suspects"] == ["tenant 7"]
+    assert any("800 turns" in e for e in skew[0]["evidence"])
+    burn = [f for f in findings if "burn" in f["title"]]
+    assert burn and "tenant 7" in burn[0]["title"]
+    assert render(findings, statuses)  # renderable end to end
+    # balanced usage + no burn → no skew finding
+    ok = {
+        "broker b": {
+            "role": "broker", "pid": 1, "metrics_enabled": True,
+            "metrics": {"families": []},
+            "accounting": _acct_payload(hot_share=0.5, rejects=0),
+        }
+    }
+    names = [f["title"] for f in diagnose(ok)]
+    assert not any("device-seconds" in t or "burn" in t for t in names)
+
+
+# -- lint --------------------------------------------------------------------
+
+
+def test_accounting_and_canary_lints(tmp_path):
+    from gol_distributed_final_tpu.obs import lint
+
+    assert lint.undocumented_canary_metrics() == []
+    assert lint.undocumented_accounting_names() == []
+    assert lint.missing_readme_sections() == []
+    bare = tmp_path / "README.md"
+    bare.write_text("# nothing\n")
+    assert "gol_canary_probes_total" in lint.undocumented_canary_metrics(bare)
+    assert "accounting_since" in lint.undocumented_accounting_names(bare)
+    missing = lint.missing_readme_sections(bare)
+    assert "## Accounting & capacity" in missing
+    assert "## Canary & load harness" in missing
