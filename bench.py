@@ -214,6 +214,7 @@ def _bench_wire_modes(extra: dict) -> int:
     import numpy as np
 
     from gol_distributed_final_tpu.obs import metrics as obs_metrics
+    from gol_distributed_final_tpu.obs import perf as obs_perf
     from gol_distributed_final_tpu.obs import timeline as obs_timeline
     from gol_distributed_final_tpu.rpc import integrity as _integrity
     from gol_distributed_final_tpu.rpc import worker as rpc_worker
@@ -233,24 +234,30 @@ def _bench_wire_modes(extra: dict) -> int:
     board = np.where(rng.random((size, size)) < 0.3, 255, 0).astype(np.uint8)
     want100 = None  # cross-mode parity reference (100 turns)
     try:
-        for wire, k, key, n_lo, n_hi, check, timeline in (
-            ("full", 1, "c7_wire_full", 30, 230, True, False),
-            ("haloed", 1, "c7_wire_haloed", 30, 230, True, False),
+        for wire, k, key, n_lo, n_hi, check, timeline, attribution in (
+            ("full", 1, "c7_wire_full", 30, 230, True, False, True),
+            ("haloed", 1, "c7_wire_haloed", 30, 230, True, False, True),
             # resident turns are much cheaper per RPC: wider endpoints so
             # the marginal work still dominates loopback timing noise
-            ("resident", 1, "c7_wire_resident_k1", 100, 1100, True, False),
-            ("resident", 8, "c7_wire_resident_k8", 100, 1100, True, False),
+            ("resident", 1, "c7_wire_resident_k1", 100, 1100, True, False, True),
+            ("resident", 8, "c7_wire_resident_k8", 100, 1100, True, False, True),
             # the same case UNDEFENDED (-integrity off, both sides): the
             # checked case above pays the in-header frame crcs + adler32
             # attestations, so the pair prices the integrity layer — the
             # overhead gate below holds it under 3% of resident turn cost
-            ("resident", 8, "c7_wire_resident_k8_nock", 100, 1100, False, False),
+            ("resident", 8, "c7_wire_resident_k8_nock", 100, 1100, False, False, True),
             # the same case with the -timeline sampler ON (1 s cadence,
             # the serving default): prices the always-on history + SLO
             # evaluation; the overhead gate below holds it under 2%
-            ("resident", 8, "c7_wire_resident_k8_timeline", 100, 1100, True, True),
+            ("resident", 8, "c7_wire_resident_k8_timeline", 100, 1100, True, True, True),
+            # the same case with the dispatch-wall decomposition + the
+            # critical-path attribution OFF (obs/perf.set_attribution):
+            # the on-vs-off pair prices the WHERE-TIME-GOES layer; the
+            # overhead gate below holds it under 2%
+            ("resident", 8, "c7_wire_resident_k8_noattr", 100, 1100, True, False, False),
         ):
             _integrity.set_enabled(check)
+            obs_perf.set_attribution(attribution)
             if timeline:
                 obs_timeline.enable(period=1.0)
             backend = WorkersBackend(addrs, wire=wire, halo_depth=k)
@@ -358,8 +365,36 @@ def _bench_wire_modes(extra: dict) -> int:
             f"{2 * tl_noise_us:.2f} us)",
             file=sys.stderr,
         )
+        # decomposition overhead gate: attribution-on (the plain checked
+        # k8 case — segments, per-call walls, the critical-path tracker)
+        # vs attribution-off, same noise-band posture — the WHERE-TIME-
+        # GOES layer must stay under 2% of resident turn cost or the
+        # "attribution always on in production" story dies here
+        na = extra["c7_wire_resident_k8_noattr"]
+        pt_na = na["per_turn_us"]
+        na_noise_us = sum(
+            c["spread_s"] / (c["n_hi"] - c["n_lo"]) * 1e6 for c in (ck, na)
+        )
+        decomp_overhead_pct = (pt_ck - pt_na) / pt_na * 100.0
+        ck["decomposition_overhead_pct"] = round(decomp_overhead_pct, 2)
+        if pt_ck - pt_na > 0.02 * pt_na + 2 * na_noise_us:
+            print(
+                f"DECOMPOSITION OVERHEAD GATE FAILURE: attribution-on "
+                f"resident k8 {pt_ck:.2f} us/turn vs off {pt_na:.2f} "
+                f"({decomp_overhead_pct:+.1f}%) exceeds 2% beyond the "
+                f"{na_noise_us:.2f} us noise band",
+                file=sys.stderr,
+            )
+            return 1
+        print(
+            f"decomposition overhead ok: attribution on {pt_ck:.2f} "
+            f"us/turn vs off {pt_na:.2f} ({decomp_overhead_pct:+.1f}%, "
+            f"band {2 * na_noise_us:.2f} us)",
+            file=sys.stderr,
+        )
     finally:
         _integrity.set_enabled(True)
+        obs_perf.set_attribution(True)
         obs_timeline.disable()
         for server, _service in servers:
             server.stop()
@@ -898,6 +933,40 @@ def _bench_body() -> int:
     rc = _bench_loadgen(extra)
     if rc:
         return rc
+
+    # roofline fields per kernel case (obs/perf.py): achieved FLOP/s and
+    # bytes/s from the analytic stencil cost model over each case's own
+    # per-turn fit, classified against this device's calibrated ceilings
+    # — so every published number carries its bound class, bench_diff
+    # gates achieved-throughput regressions per site, and the "128^2 is
+    # latency-bound" claim is a field, not a prose note
+    from gol_distributed_final_tpu.obs import perf as obs_perf
+
+    ceilings = obs_perf.calibrate()
+    for key, size in (
+        ("c2_128_pallas_bitboard", 128),
+        ("c3_512_pallas_bitboard", 512),
+        ("c3_512_engine_driven", 512),
+        ("c4_4096_tiled_bitboard", 4096),
+        ("c5_16384_sparse_bigboard", 16384),
+        ("c5_65536_sparse_bigboard", 65536),
+        ("c6_512_mesh_tax", 512),
+        ("c6_4096_mesh_tax", 4096),
+        ("c6_512_mesh_tax_wide8", 512),
+        ("c6_4096_mesh_tax_wide8", 4096),
+    ):
+        case = extra.get(key)
+        if case and (case.get("per_turn_us") or 0) > 0:
+            case.update(obs_perf.classify_case(
+                size, size, case["per_turn_us"] * 1e-6, ceilings
+            ))
+            print(
+                f"roofline {key}: {case['bound_class']} "
+                f"({100 * case['flops_utilization']:.1f}% flop, "
+                f"{100 * case['memory_utilization']:.1f}% mem of "
+                f"{ceilings.device_kind} ceilings)",
+                file=sys.stderr,
+            )
 
     # the RunReport's compact breakdown (obs/report.stage_timings): every
     # nonzero histogram series as {count, sum_s, mean_s} + nonzero counters

@@ -42,6 +42,7 @@ from ..obs import device as _device
 from ..obs import flight as _flight
 from ..obs import instruments as _ins
 from ..obs import metrics as _metrics
+from ..obs import perf as _perf
 from ..obs import timeline as _timeline
 from ..obs import tracing as _tracing
 from ..ops import alive_cells
@@ -343,6 +344,8 @@ class Engine:
             growth_done = False  # doubling ended (max_chunk OR slow dispatch)
             ckpt_error: Exception | None = None
             while True:
+                t_iter0 = time.monotonic()
+                park_dt = 0.0
                 with self._lock:
                     if self._paused and not self._quit:
                         # the park gate, timed: how long control traffic
@@ -358,9 +361,8 @@ class Engine:
                             self._control.notify_all()
                             self._control.wait()
                         _tracing.end_span(park_span)
-                        _ins.ENGINE_PARK_SECONDS.observe(
-                            time.monotonic() - t_park
-                        )
+                        park_dt = time.monotonic() - t_park
+                        _ins.ENGINE_PARK_SECONDS.observe(park_dt)
                     self._parked = False
                     if self._quit or self._turn >= params.turns:
                         break
@@ -396,6 +398,19 @@ class Engine:
                 if chunk_span is not None:
                     _tracing.end_span(chunk_span, sync=growing)
                 elapsed = time.monotonic() - t0
+                attribution = _metrics.enabled() and _perf.attribution_enabled()
+                if attribution:
+                    # dispatch-wall decomposition (obs/perf.py): planning/
+                    # lock time before the dispatch vs the dispatch itself
+                    # (block_until_ready delta on growth chunks; enqueue
+                    # wall once pipelined — the documented caveat). The
+                    # demux segment closes after the commit below.
+                    _ins.TURN_SEGMENT_SECONDS.labels(
+                        "engine", "host_prep"
+                    ).observe(max(0.0, t0 - t_iter0 - park_dt))
+                    _ins.TURN_SEGMENT_SECONDS.labels(
+                        "engine", "device_compute"
+                    ).observe(elapsed)
                 if _metrics.enabled():
                     # per-turn attribution (obs/): dispatch wall spread over
                     # the chunk's turns, so the step histogram's COUNT is
@@ -451,6 +466,7 @@ class Engine:
                     else:
                         chunk = min(chunk * 2, self.config.max_chunk)
 
+                t_commit0 = time.monotonic()
                 with self._lock:
                     prev_host = self._world_host if emit_flips else None
                     self._state = new_state
@@ -466,6 +482,10 @@ class Engine:
                     for y, x in zip(*changed):
                         emit(CellFlipped(turn_now, Cell(int(x), int(y))))
                     emit(TurnComplete(turn_now))
+                if attribution:
+                    _ins.TURN_SEGMENT_SECONDS.labels(
+                        "engine", "demux"
+                    ).observe(time.monotonic() - t_commit0)
 
                 if self.config.chunk_hook is not None:
                     # the multi-host control gate: collectives + rank-0

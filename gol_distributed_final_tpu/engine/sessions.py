@@ -54,6 +54,8 @@ from ..events import AliveCellsCount, FinalTurnComplete, TurnComplete
 from ..models import CONWAY, LifeRule
 from ..obs import accounting as _acct
 from ..obs import instruments as _ins
+from ..obs import metrics as _metrics
+from ..obs import perf as _perf
 
 #: admission-refusal reasons — the stable label set of
 #: ``gol_sessions_rejected_total`` (README "Sessions" section)
@@ -216,6 +218,8 @@ class SessionTable:
         # must always find a session in exactly one of the two lists,
         # never in the gap between them. (admit only appends and advance
         # is single-threaded, so the grabbed prefix is stable.)
+        t_adv0 = time.monotonic()
+        attribution = _metrics.enabled() and _perf.attribution_enabled()
         with self._lock:
             pending = list(self._pending)
         if pending:
@@ -254,6 +258,18 @@ class SessionTable:
         counts = self._plane.alive_counts(state)
         dt_chunk = time.monotonic() - t_chunk  # the reduction forces the
         # dispatch, so this is real time, not enqueue time
+        if attribution:
+            # dispatch-wall decomposition (obs/perf.py): join/encode of
+            # pending universes is host_prep, the forced batched dispatch
+            # is device_compute; demux (count fan-out, retirement,
+            # compaction, event delivery) closes at the bottom
+            _ins.TURN_SEGMENT_SECONDS.labels(
+                "sessions", "host_prep"
+            ).observe(max(0.0, t_chunk - t_adv0))
+            _ins.TURN_SEGMENT_SECONDS.labels(
+                "sessions", "device_compute"
+            ).observe(dt_chunk)
+        t_demux0 = time.monotonic()
 
         events: List[tuple[Session, object]] = []
         finished: List[int] = []
@@ -341,6 +357,10 @@ class SessionTable:
         if finished:
             for i in finished:
                 active[i].done.set()
+        if attribution and (advanced or finished):
+            _ins.TURN_SEGMENT_SECONDS.labels("sessions", "demux").observe(
+                time.monotonic() - t_demux0
+            )
         return left
 
     def fail_all(self, exc: Exception) -> None:

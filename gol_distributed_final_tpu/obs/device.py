@@ -72,8 +72,61 @@ def _is_traced(args) -> bool:
             return False
     return any(isinstance(a, _TRACER_CLS) for a in args)
 
-# (site, id(jitted), abstract key) -> Compiled | None for compile_and_call
+# (site, id(jitted), abstract key) -> _Entry | None for compile_and_call
 _CALL_CACHE: Dict[tuple, object] = {}
+
+# per-site dispatch accounting (obs/perf.py's roofline join): every
+# instrumented executable call adds its program's cost-analysis flops /
+# bytes and its measured host-side wall, so achieved FLOP/s and bytes/s
+# are exact even when a site mixes programs (different chunk sizes)
+_DISPATCH_STATS: Dict[str, list] = {}  # site -> [flops, bytes, wall_s, calls]
+_DISPATCH_LOCK = threading.Lock()
+
+
+class _Entry:
+    """One cached AOT decision: the compiled executable plus the program's
+    cost-analysis estimates (0.0 when the backend reported none), so the
+    dispatch path can attribute flops/bytes per executed call."""
+
+    __slots__ = ("compiled", "flops", "bytes_accessed")
+
+    def __init__(self, compiled, flops: float, bytes_accessed: float):
+        self.compiled = compiled
+        self.flops = flops
+        self.bytes_accessed = bytes_accessed
+
+
+def _note_dispatch(site: str, entry: "_Entry", wall_s: float) -> None:
+    """Fold one executed call into the per-site roofline accumulators and
+    the gol_kernel_dispatch_seconds histogram."""
+    _ins.KERNEL_DISPATCH_SECONDS.labels(site).observe(wall_s)
+    with _DISPATCH_LOCK:
+        stats = _DISPATCH_STATS.setdefault(site, [0.0, 0.0, 0.0, 0])
+        stats[0] += entry.flops
+        stats[1] += entry.bytes_accessed
+        stats[2] += wall_s
+        stats[3] += 1
+
+
+def dispatch_stats() -> Dict[str, dict]:
+    """Per-site dispatch totals: ``{site: {flops, bytes_accessed, wall_s,
+    calls}}`` — obs/perf.py's achieved-throughput input."""
+    with _DISPATCH_LOCK:
+        return {
+            site: {
+                "flops": s[0],
+                "bytes_accessed": s[1],
+                "wall_s": s[2],
+                "calls": s[3],
+            }
+            for site, s in _DISPATCH_STATS.items()
+        }
+
+
+def reset_dispatch() -> None:
+    """Forget the dispatch accumulators (tests / bench isolation)."""
+    with _DISPATCH_LOCK:
+        _DISPATCH_STATS.clear()
 
 # per-device high-water mark of bytes_in_use, across every sample this
 # process ever took — what the RunReport publishes as the peak SEEN, not
@@ -101,9 +154,10 @@ def _abstract_key(args) -> tuple:
 
 def _timed_compile(site: str, jitted, args):
     """Explicit AOT lower+compile with the wall clock around it, recording
-    compile seconds and the lowered cost analysis. Returns the Compiled
-    executable, or None if anything failed (caller falls back to the
-    plain jitted call — which re-raises any REAL compile error)."""
+    compile seconds and the lowered cost analysis. Returns an ``_Entry``
+    (executable + its cost estimates), or None if anything failed (caller
+    falls back to the plain jitted call — which re-raises any REAL
+    compile error)."""
     try:
         t0 = time.monotonic()
         lowered = jitted.lower(*args)
@@ -111,6 +165,7 @@ def _timed_compile(site: str, jitted, args):
         _ins.COMPILE_SECONDS.labels(site).observe(time.monotonic() - t0)
     except Exception:
         return None
+    flops = accessed = 0.0
     try:
         ca = lowered.cost_analysis()
         # older jax versions return a per-device list, newer a flat dict
@@ -128,7 +183,7 @@ def _timed_compile(site: str, jitted, args):
     # cost_analysis() support would log every single compile
     except Exception:
         pass
-    return compiled
+    return _Entry(compiled, float(flops or 0.0), float(accessed or 0.0))
 
 
 def instrument_jit(site: str, jitted):
@@ -163,7 +218,8 @@ def instrument_jit(site: str, jitted):
         if entry is None:
             return jitted(*args)
         try:
-            return entry(*args)
+            t0 = time.monotonic()
+            out = entry.compiled(*args)
         except (TypeError, ValueError):
             # the executable's ARGUMENT checks (input pytree / committed
             # sharding mismatch) reject before anything runs: route this
@@ -174,6 +230,8 @@ def instrument_jit(site: str, jitted):
             # the original traceback.
             cache[key] = None
             return jitted(*args)
+        _note_dispatch(site, entry, time.monotonic() - t0)
+        return out
 
     call.__wrapped__ = jitted
     return call
@@ -203,12 +261,15 @@ def compile_and_call(site: str, jitted, *args, static_argnums=()):
         return jitted(*args)
     dynamic = tuple(a for i, a in enumerate(args) if i not in static_argnums)
     try:
-        return entry(*dynamic)
+        t0 = time.monotonic()
+        out = entry.compiled(*dynamic)
     except (TypeError, ValueError):
         # argument-check rejection only — runtime failures propagate
         # (see instrument_jit's call path for the rationale)
         _CALL_CACHE[key] = None
         return jitted(*args)
+    _note_dispatch(site, entry, time.monotonic() - t0)
+    return out
 
 
 # -- HBM sampling -------------------------------------------------------------

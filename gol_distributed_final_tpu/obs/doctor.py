@@ -373,6 +373,43 @@ def _find_tenant_skew(statuses) -> List[dict]:
     return out
 
 
+def _find_straggler(statuses) -> List[dict]:
+    """The critical-path correlation (obs/critical.py snapshot riding the
+    broker's Status): a worker that persistently GATES the K-batch
+    gather — slow, not failed, so nothing else pages — is named with
+    per-address service-time evidence rows. This is the finding that
+    explains 'the cluster is healthy but turns are slow'."""
+    out = []
+    for label, payload in statuses.items():
+        cp = payload.get("critical_path") or {}
+        s = cp.get("straggler")
+        if not s:
+            continue
+        rows = [
+            f"{w.get('addr', '?')}: service ewma "
+            f"{(w.get('ewma_s') or 0.0) * 1e3:.1f} ms, gated "
+            f"{w.get('gated', 0)}/{cp.get('batches', 0)} batch(es) "
+            f"({100 * (w.get('gated_share') or 0.0):.0f}%)"
+            for w in cp.get("workers") or []
+        ]
+        out.append(_finding(
+            "warn",
+            85.0 + min(10.0, 2.0 * (s.get("skew") or 0.0)),
+            f"worker {s.get('addr', '?')} is the persistent straggler — "
+            f"gated {100 * (s.get('gated_share') or 0.0):.0f}% of "
+            f"{cp.get('batches', 0)} K-batch gather(s)",
+            "every fan-out turn completes at the slowest worker: this "
+            f"one runs at {s.get('skew', 0.0):.1f}x the roster's median "
+            "service time, so it sets the whole cluster's turn rate. "
+            "Nothing has failed, so only this attribution sees it. "
+            "Rebalance its strip share, or drain and replace the host.",
+            rows,
+            [s.get("addr", "?")],
+            label,
+        ))
+    return out
+
+
 def _find_hbm(statuses) -> List[dict]:
     out = []
     for label, payload in statuses.items():
@@ -426,6 +463,7 @@ _HEURISTICS = (
     _find_integrity,
     _find_alerts,
     _find_error_ratio,
+    _find_straggler,
     _find_tenant_skew,
     _find_stall,
     _find_hbm,
@@ -537,6 +575,84 @@ def write_report(
     return path
 
 
+# artifact globs a bundle collects out of the artifact directory — the
+# five files post-hoc triage used to mean hand-gathering. Newest-first
+# per pattern, capped so a long-lived out/ does not balloon the bundle.
+# The accounting ledger has no on-disk artifact of its own: it rides
+# each target's FULL Status payload, which the bundle writes verbatim.
+_BUNDLE_GLOBS = (
+    ("trace", "trace_*.json", 3),
+    ("flight", "flight_*.jsonl", 3),
+    ("report", "report_*.json", 3),
+    ("doctor", "doctor_*.json", 3),
+    ("analysis", "analysis.json", 1),
+)
+
+
+def write_bundle(
+    findings: List[dict], statuses: Dict[str, dict], out_dir="out"
+) -> pathlib.Path:
+    """One ``out/bundle_<ts>/`` incident directory: the diagnosis, every
+    target's FULL Status payload (metrics + timeline + flight ring +
+    accounting — the live evidence), and copies of the existing on-disk
+    artifacts (traces, flight dumps, run reports, prior diagnoses, the
+    analysis posture), indexed by a ``manifest.json`` — so post-hoc
+    triage is one directory to attach, not five files to hand-gather."""
+    import shutil
+
+    out = pathlib.Path(out_dir)
+    bdir = out / f"bundle_{int(time.time())}"
+    bdir.mkdir(parents=True, exist_ok=True)
+    entries = []
+
+    def _write(name: str, payload, source: str) -> None:
+        path = bdir / name
+        path.write_text(json.dumps(payload, indent=1, default=str))
+        entries.append({
+            "file": name, "source": source, "bytes": path.stat().st_size,
+        })
+
+    _write(
+        "doctor.json",
+        {"schema": SCHEMA, "generated_unix": time.time(),
+         "findings": findings},
+        "diagnosis",
+    )
+    for label, payload in statuses.items():
+        slug = label.replace(" ", "_").replace(":", "").replace("/", "_")
+        _write(f"status_{slug}.json", payload, f"live Status poll: {label}")
+    for kind, pattern, keep in _BUNDLE_GLOBS:
+        found = sorted(
+            out.glob(pattern), key=lambda p: p.stat().st_mtime, reverse=True
+        )
+        for src in found[:keep]:
+            if bdir in src.parents:
+                continue  # never re-collect this bundle's own files
+            dst = bdir / src.name
+            try:
+                shutil.copy2(src, dst)
+            except OSError as exc:
+                entries.append({
+                    "file": src.name, "source": f"{kind} artifact",
+                    "error": str(exc),
+                })
+                continue
+            entries.append({
+                "file": src.name, "source": f"{kind} artifact ({src})",
+                "bytes": dst.stat().st_size,
+            })
+    manifest = {
+        "schema": "gol-bundle/1",
+        "generated_unix": time.time(),
+        "targets": sorted(statuses),
+        "entries": entries,
+    }
+    (bdir / "manifest.json").write_text(
+        json.dumps(manifest, indent=1, default=str)
+    )
+    return bdir
+
+
 def _selfcheck(out_dir: str) -> int:
     """The ``scripts/check --doctor`` smoke: loopback broker, tiny run,
     poll + diagnose + render + write, fail on empty/unrenderable."""
@@ -614,6 +730,14 @@ def main(argv=None) -> int:
         help="print the JSON report to stdout instead of the terminal text",
     )
     parser.add_argument(
+        "-bundle", action="store_true",
+        help="also collect a full incident bundle: out/bundle_<ts>/ with "
+             "the diagnosis, every target's full Status payload (metrics "
+             "+ timeline + flight + accounting), and copies of the "
+             "existing trace/flight/report/analysis artifacts, indexed "
+             "by manifest.json",
+    )
+    parser.add_argument(
         "--selfcheck", action="store_true",
         help="spin a loopback broker, run a tiny job, diagnose it, and "
              "fail on an empty diagnosis (the scripts/check --doctor gate)",
@@ -626,6 +750,9 @@ def main(argv=None) -> int:
     statuses = collect(args.address, args.worker, timeout=args.timeout)
     findings = diagnose(statuses)
     path = write_report(findings, statuses, args.out)
+    if args.bundle:
+        bdir = write_bundle(findings, statuses, args.out)
+        print(f"incident bundle collected at {bdir}", file=sys.stderr)
     if args.json:
         print(json.dumps(
             {"findings": findings, "report_path": str(path)},

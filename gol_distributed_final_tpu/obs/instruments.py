@@ -395,3 +395,66 @@ HBM_BYTES_LIMIT = _R.gauge(
     "Device memory capacity (memory_stats bytes_limit).",
     labelnames=("device",),
 )
+
+# -- performance attribution (obs/perf.py roofline, obs/critical.py
+#    straggler/critical-path, dispatch-wall decomposition) -------------------
+
+KERNEL_DISPATCH_SECONDS = _R.histogram(
+    "gol_kernel_dispatch_seconds",
+    "Host-side wall of one instrumented compiled-executable call "
+    "(obs/device.py AOT path), by site — the measured-dispatch-wall half "
+    "of the roofline join (gol_kernel_flops / _bytes_accessed are the "
+    "cost half). Pipelined callers that only enqueue record enqueue "
+    "time; callers that sync (growth chunks, count reductions) record "
+    "real device wall — the honest-caveat split the README documents.",
+    labelnames=("site",),
+)
+KERNEL_ACHIEVED_FLOPS = _R.gauge(
+    "gol_kernel_achieved_flops",
+    "Achieved FLOP/s at a kernel site: XLA cost-analysis flops executed "
+    "divided by measured dispatch wall, over every instrumented call so "
+    "far (obs/perf.refresh_metrics sets it on Status polls and report "
+    "writes).",
+    labelnames=("site",),
+)
+KERNEL_ACHIEVED_BYTES = _R.gauge(
+    "gol_kernel_achieved_bytes_per_s",
+    "Achieved memory throughput at a kernel site: cost-analysis bytes "
+    "accessed divided by measured dispatch wall (gol_kernel_achieved_"
+    "flops's memory twin).",
+    labelnames=("site",),
+)
+KERNEL_BOUND = _R.gauge(
+    "gol_kernel_bound",
+    "Roofline classification of a kernel site against the calibrated "
+    "device ceilings (obs/perf.py): 1 on the site's current class "
+    "(compute-bound / memory-bound / launch-bound), 0 on the others.",
+    labelnames=("site", "class"),
+)
+TURN_SEGMENT_SECONDS = _R.histogram(
+    "gol_turn_segment_seconds",
+    "Dispatch-wall decomposition: each turn-chunk/K-batch's wall split "
+    "into host_prep (planning, encode, request assembly), "
+    "device_compute (kernel/worker compute — block_until_ready delta "
+    "on the engine, the gating worker's reported service time on the "
+    "broker), wire (round-trip wall minus service, workers backend "
+    "only), and demux (reply validation, commit, event fan-out), by "
+    "component (engine / sessions / broker) and segment — the "
+    "WHERE-TIME-GOES panel's feed.",
+    labelnames=("component", "segment"),
+)
+STRIP_STEP_SECONDS = _R.histogram(
+    "gol_strip_step_seconds",
+    "Per-worker StripStep round-trip wall as the broker measured it "
+    "(resident wire mode), by worker address — the straggler/critical-"
+    "path feed (obs/critical.py): per K-batch the slowest of these "
+    "gated the gather.",
+    labelnames=("addr",),
+)
+WORKER_SKEW_RATIO = _R.gauge(
+    "gol_worker_skew_ratio",
+    "Worst per-worker service-time skew: the slowest worker's "
+    "service-time EWMA over the roster median (obs/critical.py), "
+    "updated per K-batch — 1.0 is a balanced roster; the 'worker-skew' "
+    "SLO GrowthRule alerts on its drift.",
+)

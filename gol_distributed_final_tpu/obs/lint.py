@@ -84,6 +84,10 @@ _REQUIRED_SECTIONS = (
     # the blackbox measurement surface (obs/canary.py + obs/loadgen.py):
     # probe verbs, metric tables, loadgen CLI examples
     "## Canary & load harness",
+    # the roofline/straggler attribution contract (obs/perf.py +
+    # obs/critical.py): metric table, bound-class semantics, CLI
+    # examples, and the honest calibration caveats
+    "## Performance attribution",
 )
 
 # the wire data-plane metric families (rpc/protocol.py frames + the
@@ -246,6 +250,34 @@ def undocumented_accounting_names(readme_path=None) -> List[str]:
     return sorted(n for n in _ACCOUNTING_DOC_NAMES if n not in section)
 
 
+# the performance-attribution metric families (obs/perf.py roofline,
+# obs/critical.py straggler, the dispatch-wall decomposition) plus the
+# classifier's stable class vocabulary: these must be documented in the
+# README's "Performance attribution" section specifically — the contract
+# the next perf PR's admission gate reads
+_PERF_METRIC_NAMES = (
+    "gol_kernel_dispatch_seconds",
+    "gol_kernel_achieved_flops",
+    "gol_kernel_achieved_bytes_per_s",
+    "gol_kernel_bound",
+    "gol_turn_segment_seconds",
+    "gol_strip_step_seconds",
+    "gol_worker_skew_ratio",
+    "compute-bound",
+    "memory-bound",
+    "launch-bound",
+)
+
+
+def undocumented_perf_names(readme_path=None) -> List[str]:
+    """Performance-attribution metric/class names missing from the
+    README's "Performance attribution" section specifically (the
+    wire/device-table posture: a name mentioned elsewhere in the file
+    does not count as documented here)."""
+    section = _readme_section(readme_path, "## Performance attribution")
+    return sorted(n for n in _PERF_METRIC_NAMES if n not in section)
+
+
 def missing_readme_sections(readme_path=None) -> List[str]:
     """Required operator-facing README sections that are absent."""
     if readme_path is None:
@@ -337,6 +369,14 @@ CHECKS = (
         "& capacity section:",
         "accounting lint ok: the reconciliation contract is documented "
         "in the Accounting & capacity section",
+    ),
+    (
+        "lint-perf-metrics",
+        undocumented_perf_names,
+        "performance-attribution metric/class names missing from "
+        "README.md's Performance attribution section:",
+        "perf lint ok: every attribution metric and bound class is in "
+        "the Performance attribution section",
     ),
     (
         "lint-sections",

@@ -190,6 +190,7 @@ def compare_case(
     # regression must not hide behind an unusable timing.
     if not old_us or not new_us:
         out["verdict"] = "incomparable"
+        out = _apply_roofline_gate(old, new, out, threshold, 0.0)
         return _apply_wire_bytes_gate(old, new, out, threshold)
     delta = new_us - old_us
     rel = delta / old_us
@@ -210,7 +211,42 @@ def compare_case(
         out["verdict"] = "REGRESSED" if rel > threshold else "slower"
     else:
         out["verdict"] = "improved" if -rel > threshold else "faster"
+    out = _apply_roofline_gate(old, new, out, threshold, noise_us / old_us)
     return _apply_wire_bytes_gate(old, new, out, threshold)
+
+
+def _apply_roofline_gate(
+    old: dict, new: dict, out: dict, threshold: float, noise_rel: float
+) -> dict:
+    """The achieved-throughput gate (obs/perf.py roofline fields embedded
+    per kernel case from bench.py): a per-SITE drop in achieved FLOP/s
+    past the threshold AND the noise band is REGRESSED in its own units.
+    HONESTY NOTE: while both rounds' fields come from the analytic cost
+    model over the same case's fit (the current bench), this is
+    mathematically redundant with the wall-clock gate (flops ∝ 1/wall,
+    same constant) — it becomes load-bearing when the sides' throughput
+    sources diverge: a future bench embedding MEASURED dispatch-stats
+    throughput, a model-constant change between rounds, or a salvaged
+    side whose wall-clock fit broke but whose embedded fields survived.
+    A bound-class flip (e.g. memory-bound -> launch-bound) is always
+    REPORTED; it only gates when the throughput drop does (a class is a
+    coarse call and a flip alone can be a utilization hovering at the
+    boundary)."""
+    old_f, new_f = old.get("achieved_flops"), new.get("achieved_flops")
+    if old_f and new_f:
+        drop_rel = (old_f - new_f) / old_f
+        out["old_achieved_flops"] = old_f
+        out["new_achieved_flops"] = new_f
+        out["achieved_delta_pct"] = -100.0 * drop_rel
+        if drop_rel > threshold + noise_rel:
+            out["verdict"] = "REGRESSED"
+            out["why"] = (
+                "achieved FLOP/s fell past threshold beyond the noise band"
+            )
+    old_c, new_c = old.get("bound_class"), new.get("bound_class")
+    if old_c and new_c and old_c != new_c:
+        out["bound_class_change"] = f"{old_c} -> {new_c}"
+    return out
 
 
 def _apply_wire_bytes_gate(
@@ -276,25 +312,37 @@ def render_table(verdicts: Dict[str, dict]) -> str:
     for name, v in verdicts.items():
         delta = v.get("delta_pct")
         noise = v.get("noise_pct")
+        tail = v["verdict"]
+        if v.get("bound_class_change"):
+            tail += f"  [{v['bound_class_change']}]"
         lines.append(
             f"{name:<28} {_fmt_us(v.get('old_us')):>10} "
             f"{_fmt_us(v.get('new_us')):>10} "
             f"{(f'{delta:+.1f}' if delta is not None else '-'):>8} "
             f"{(f'{noise:.1f}' if noise is not None else '-'):>8}  "
-            f"{v['verdict']}"
+            f"{tail}"
         )
     return "\n".join(lines)
 
 
+# a bench ROUND and nothing else: MULTICHIP_r*.json and friends share the
+# _r<N>.json suffix and a lax pattern would sort them into the rounds —
+# the exact-name match is the selection contract (test-pinned)
+_ROUND_RE = re.compile(r"^BENCH_r(\d+)\.json$")
+
+
 def latest_bench_files(directory=".") -> List[pathlib.Path]:
     """The BENCH_r*.json rounds of a repo, oldest to newest by round
-    number (lexical sort breaks at r10 without the numeric key)."""
-
-    def round_no(p: pathlib.Path) -> int:
-        m = re.search(r"r(\d+)", p.name)
-        return int(m.group(1)) if m else -1
-
-    return sorted(pathlib.Path(directory).glob("BENCH_r*.json"), key=round_no)
+    number (lexical sort breaks at r10 without the numeric key).
+    STRICTLY ``BENCH_r<number>.json``: other result files in the same
+    directory (``MULTICHIP_r*.json``, a stray ``BENCH_rX.json``) are
+    ignored, never sorted into the rounds ``--latest`` gates on."""
+    out = []
+    for p in pathlib.Path(directory).glob("BENCH_r*.json"):
+        m = _ROUND_RE.match(p.name)
+        if m:
+            out.append((int(m.group(1)), p))
+    return [p for _, p in sorted(out)]
 
 
 def main(argv=None) -> int:

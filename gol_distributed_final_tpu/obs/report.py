@@ -22,6 +22,8 @@ import time
 from typing import Optional
 
 from . import accounting, flight, metrics, timeline, tracing
+from . import critical as _critical
+from . import perf as _perf
 
 SCHEMA = "gol-run-report/1"
 
@@ -47,6 +49,11 @@ def status_payload(
     plus the SLO rulebook's alert states (obs/slo.py), so one poll sees
     cluster health without client-side reconstruction."""
     reg = metrics.registry()
+    # refresh the roofline gauges BEFORE the snapshot: a process with
+    # instrumented kernel dispatches publishes achieved FLOP/s, bytes/s,
+    # and bound classes on its own poll (obs/perf.py; no-op — and still
+    # jax-free — in a process that never dispatched)
+    _perf.refresh_metrics()
     payload = {
         "schema": "gol-status/1",
         "pid": os.getpid(),
@@ -54,6 +61,11 @@ def status_payload(
         "metrics_enabled": reg.enabled,
         "metrics": reg.snapshot(),
     }
+    cp = _critical.tracker().snapshot()
+    if cp.get("batches"):
+        # straggler/critical-path attribution (obs/critical.py) — the
+        # doctor's 'straggler' heuristic and the watch panel read this
+        payload["critical_path"] = cp
     if tracing.enabled():
         payload["trace_spans"] = tracing.tracer().snapshot()
     if flight.enabled():
@@ -160,6 +172,7 @@ def write_run_report(
     """Dump the registry + device inventory for a finished run. Written to
     a temp name then renamed, like the checkpoint writer, so a crash
     mid-dump never leaves a half-parseable report."""
+    _perf.refresh_metrics()  # achieved/bound gauges land in the snapshot
     snap = metrics.registry().snapshot()
     report = {
         "schema": SCHEMA,
@@ -194,6 +207,14 @@ def write_run_report(
         # who spent this run's capacity: the bounded per-tenant ledger
         # rides the final artifact beside the timeline verdict
         report["accounting"] = ledger.window()
+    decomp = _perf.decomposition_summary(snap)
+    if decomp:
+        # WHERE the wall went: the dispatch-wall decomposition breakdown
+        # (host_prep / device_compute / wire / demux per component)
+        report["where_time_goes"] = decomp
+    cp = _critical.tracker().snapshot()
+    if cp.get("batches"):
+        report["critical_path"] = cp
     if extra:
         report.update(extra)
     path = report_path(params, out_dir)

@@ -311,6 +311,17 @@ def default_rules() -> List[Rule]:
             "gol_scatter_deadline_seconds", factor=3.0, window_s=120.0,
             floor=1.0,
         ),
+        # per-worker service-time skew (obs/critical.py: slowest EWMA /
+        # roster median, updated per K-batch): every fan-out turn lands
+        # at the slowest worker's pace, so a skew that DOUBLES means one
+        # host quietly started setting the whole cluster's turn rate —
+        # the straggler signal before anything fails. floor 1.5 keeps a
+        # balanced roster's jitter (~1.0) from ever arming it.
+        GrowthRule(
+            "worker-skew", "warn",
+            "gol_worker_skew_ratio", factor=2.0, window_s=120.0,
+            floor=1.5,
+        ),
     ]
 
 
@@ -325,6 +336,7 @@ DEFAULT_RULE_NAMES = (
     "rpc-dispatch-latency",
     "hbm-headroom",
     "scatter-deadline-growth",
+    "worker-skew",
 )
 
 

@@ -358,6 +358,85 @@ def _integrity_lines(snap: dict) -> List[str]:
     return out
 
 
+def _where_time_lines(snap: dict) -> List[str]:
+    """The dispatch-wall decomposition panel (obs/perf.py): per component
+    (engine / sessions / broker), where each turn-chunk's wall went —
+    host_prep / device_compute / wire / demux totals and shares. Servers
+    that never decomposed a chunk render nothing."""
+    from .perf import decomposition_summary
+
+    decomp = decomposition_summary(snap)
+    if not decomp:
+        return []
+    out = ["WHERE TIME GOES (dispatch-wall decomposition)"]
+    for component, segs in sorted(decomp.items()):
+        parts = [
+            f"{seg} {_human_seconds(e['sum_s'])} ({100 * e['share']:.0f}%)"
+            for seg, e in sorted(segs.items())
+            if isinstance(e, dict)
+        ]
+        total = segs.get("_total_s") or 0.0
+        out.append(
+            f"  {component:<9} {_human_seconds(total):>9}   " + "  ".join(parts)
+        )
+    return out
+
+
+def _critical_lines(payload: dict) -> List[str]:
+    """The straggler/critical-path panel (obs/critical.py snapshot in the
+    Status payload): per-worker service-time EWMAs, who gated how many
+    K-batches, and the straggler headline when one worker persistently
+    gates the gather."""
+    cp = payload.get("critical_path") or {}
+    workers = cp.get("workers") or []
+    if not cp.get("batches") or not workers:
+        return []
+    out = [
+        f"CRITICAL PATH ({cp.get('batches')} batch(es), skew "
+        f"{cp.get('skew_ratio', 1.0):.2f}x)          ewma    gated  share"
+    ]
+    for w in workers:
+        ewma = w.get("ewma_s")
+        out.append(
+            f"  {w.get('addr', '?'):<24} "
+            f"{(_human_seconds(ewma) if ewma is not None else '-'):>10} "
+            f"{w.get('gated', 0):>6} "
+            f"{100 * (w.get('gated_share') or 0.0):>5.0f}%"
+        )
+    s = cp.get("straggler")
+    if s:
+        out.append(
+            f"  ** STRAGGLER {s.get('addr', '?')}: gates "
+            f"{100 * (s.get('gated_share') or 0):.0f}% of batches at "
+            f"{s.get('skew', 0):.1f}x the roster median **"
+        )
+    return out
+
+
+def _roofline_lines(snap: dict) -> List[str]:
+    """The roofline classification panel (obs/perf.py): achieved FLOP/s
+    and bytes/s per instrumented kernel site plus the bound class the
+    server classified it as (the gol_kernel_bound gauge). Servers
+    without instrumented dispatches render nothing."""
+    from .perf import server_bound_classes
+
+    achieved_f = _series_map(snap, "gol_kernel_achieved_flops")
+    achieved_b = _series_map(snap, "gol_kernel_achieved_bytes_per_s")
+    if not achieved_f:
+        return []
+    classes = server_bound_classes(snap)
+    out = ["ROOFLINE (achieved per site)"]
+    for labels in sorted(achieved_f):
+        site = labels[0] if labels else "?"
+        af = (achieved_f.get(labels) or {}).get("value") or 0.0
+        ab = (achieved_b.get(labels) or {}).get("value") or 0.0
+        out.append(
+            f"  {site:<18} {af:.3g} flop/s   {_human_bytes(ab)}/s   "
+            f"{classes.get(site, '?')}"
+        )
+    return out
+
+
 def _compile_lines(snap: dict) -> List[str]:
     requests = _series_map(snap, "gol_compile_cache_requests_total")
     misses = _series_map(snap, "gol_compile_cache_misses_total")
@@ -455,6 +534,9 @@ def render_status(
         _tenant_lines(payload),
         _integrity_lines(snap),
         _worker_lines(payload),
+        _where_time_lines(snap),
+        _critical_lines(payload),
+        _roofline_lines(snap),
         _compile_lines(snap),
         _hbm_lines(snap),
         _flight_lines(payload),

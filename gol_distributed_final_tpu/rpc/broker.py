@@ -34,8 +34,11 @@ import time
 import numpy as np
 
 from ..engine.engine import Engine, RunResult, Snapshot
+from ..obs import critical as _critical
 from ..obs import flight as _flight
 from ..obs import instruments as _ins
+from ..obs import metrics as _metrics
+from ..obs import perf as _perf
 from ..obs import tracing as _tracing
 from . import faults as _faults
 from . import integrity as _integrity
@@ -429,7 +432,8 @@ class WorkersBackend:
         its gather simply hangs on worker death)."""
         import concurrent.futures
 
-        def scatter(client, world, s, e, deadline, trace_parent=None):
+        def scatter(client, world, s, e, deadline, trace_parent=None,
+                    sink=None, idx=0):
             # _call_worker handles the pool-thread plumbing: deadline
             # bounds the REPLY wait (a wedged worker costs one deadline,
             # never the whole run) and trace_parent rides in explicitly
@@ -440,6 +444,11 @@ class WorkersBackend:
             else:
                 rows = np.arange(s - 1, e + 1) % h
                 req = Request(world=world[rows], start_y=-1)
+            if sink is not None:
+                return self._timed_call(
+                    client, Methods.WORKER_UPDATE, req, deadline,
+                    trace_parent, sink, idx,
+                ).work_slice
             return self._call_worker(
                 client, Methods.WORKER_UPDATE, req, deadline, trace_parent
             ).work_slice
@@ -476,6 +485,7 @@ class WorkersBackend:
                 tp = turn_span.ctx() if turn_span else None
                 t_turn = time.monotonic()
                 had_loss = False
+                attribution = self._attribution_on()
                 try:
                     while True:  # retries the SAME turn after losing workers
                         # re-snapshot each attempt AND each turn: the probe
@@ -486,18 +496,25 @@ class WorkersBackend:
                             raise RpcError("all workers lost mid-run")
                         n, bounds = plan(active)
                         deadline = self._scatter_deadline()
+                        # a fresh sink per attempt: a retried turn's dead
+                        # replies must not pollute the committed batch's
+                        # critical-path attribution
+                        sink = [] if attribution else None
+                        t_attempt = time.monotonic()
                         futures = [
                             pool.submit(
                                 scatter, active[i], world, *bounds[i],
-                                deadline, tp,
+                                deadline, tp, sink, i,
                             )
                             for i in range(n)
                         ]
+                        t_submitted = time.monotonic()
                         # _bounded_gather time-bounds the gather beyond the
                         # reply deadline (a scatter thread stuck in sendall
                         # must not hang fut.result() — the send allowance
                         # rationale lives on the helper)
                         strips, dead = self._bounded_gather(futures, deadline)
+                        t_gathered = time.monotonic()
                         if not dead:
                             break
                         with self._lock:
@@ -522,7 +539,16 @@ class WorkersBackend:
                         self._turn += 1
                         self._sync_turn = self._turn  # a fresh full world
                         self._record_alive(self._turn, count)
+                        turn_now = self._turn
                     _ins.TURN_BATCH_SIZE.observe(1)
+                    if attribution:
+                        self._feed_critical(sink, active, turn_now, 1)
+                        self._observe_segments(
+                            t_submitted - t_attempt,
+                            t_gathered - t_submitted,
+                            time.monotonic() - t_gathered,
+                            sink,
+                        )
                 finally:
                     # ends on every exit — commit, shutdown race, all-lost
                     # raise — so a wedged NEXT turn is the one left open
@@ -557,6 +583,59 @@ class WorkersBackend:
         if trace_parent is not None:
             kw["trace_parent"] = trace_parent
         return client.call(method, req, **kw)
+
+    @staticmethod
+    def _attribution_on() -> bool:
+        """Hot-loop guard for the dispatch-wall decomposition + critical-
+        path feeds: metrics on AND obs/perf's attribution switch on (the
+        bench's ≤2% decomposition-overhead gate A/Bs the switch)."""
+        return _metrics.enabled() and _perf.attribution_enabled()
+
+    def _timed_call(self, client, method, req, deadline, tp, sink, idx):
+        """``_call_worker`` with per-call attribution: appends
+        ``(idx, round_trip_s, service_s | None)`` to ``sink`` (service is
+        the worker-reported handler wall — getattr: an older worker's
+        reply lacks the field and the split degrades to round trip).
+        list.append is atomic, so pool threads share the sink lock-free."""
+        t0 = time.monotonic()
+        res = self._call_worker(client, method, req, deadline, tp)
+        service = getattr(res, "service_seconds", 0.0)
+        sink.append((idx, time.monotonic() - t0, service or None))
+        return res
+
+    def _feed_critical(self, sink, active, turn, k, strip=False):
+        """Commit one batch's per-worker walls: per-addr StripStep
+        histogram (resident mode) + the critical-path tracker
+        (obs/critical.py), whose snapshot rides the Status payload."""
+        if not sink:
+            return
+        with self._lock:
+            addrs = {
+                id(c): self._client_addr.get(id(c), "<local>") for c in active
+            }
+        entries = []
+        for idx, rt, service in sink:
+            addr = addrs.get(id(active[idx]), "<local>")
+            if strip:
+                _ins.STRIP_STEP_SECONDS.labels(addr).observe(rt)
+            entries.append((addr, rt, service))
+        _critical.tracker().record_batch(entries, turn=turn, k=k)
+
+    @staticmethod
+    def _observe_segments(host_prep, gather, demux, sink):
+        """One batch's dispatch-wall decomposition: the gather wall splits
+        into the gating worker's reported service time (device_compute)
+        and the residual wire time; a roster of non-reporting workers
+        books the whole gather as wire (the honest degradation)."""
+        compute = 0.0
+        if sink:
+            gating = max(sink, key=lambda e: e[1])
+            compute = min(gating[2] or 0.0, gather)
+        seg = _ins.TURN_SEGMENT_SECONDS
+        seg.labels("broker", "host_prep").observe(max(0.0, host_prep))
+        seg.labels("broker", "device_compute").observe(compute)
+        seg.labels("broker", "wire").observe(max(0.0, gather - compute))
+        seg.labels("broker", "demux").observe(max(0.0, demux))
 
     def _bounded_gather(self, futures, deadline):
         """``(results, dead_indices)`` with the scatter loop's time bound:
@@ -880,6 +959,8 @@ class WorkersBackend:
                 )
                 tp = turn_span.ctx() if turn_span else None
                 t_batch = time.monotonic()
+                attribution = self._attribution_on()
+                sink = [] if attribution else None
                 try:
                     deadline = self._scatter_deadline()
                     futures = []
@@ -890,22 +971,26 @@ class WorkersBackend:
                         # FIRST k (n == 1 wraps onto itself)
                         top = plan.edges[(i - 1) % n][1][-k:]
                         bottom = plan.edges[(i + 1) % n][0][:k]
-                        futures.append(
-                            pool.submit(
-                                self._call_worker,
-                                plan.active[i],
-                                Methods.STRIP_STEP,
-                                Request(
-                                    world=np.concatenate([top, bottom], axis=0),
-                                    worker=i,
-                                    turns=k,
-                                    initial_turn=turn0,
-                                ),
-                                deadline,
-                                tp,
-                            )
+                        req_i = Request(
+                            world=np.concatenate([top, bottom], axis=0),
+                            worker=i,
+                            turns=k,
+                            initial_turn=turn0,
                         )
+                        if sink is not None:
+                            futures.append(pool.submit(
+                                self._timed_call, plan.active[i],
+                                Methods.STRIP_STEP, req_i, deadline, tp,
+                                sink, i,
+                            ))
+                        else:
+                            futures.append(pool.submit(
+                                self._call_worker, plan.active[i],
+                                Methods.STRIP_STEP, req_i, deadline, tp,
+                            ))
+                    t_submitted = time.monotonic()
                     results, dead = self._bounded_gather(futures, deadline)
+                    t_gathered = time.monotonic()
                     check = _integrity.enabled()
                     attests = [None] * n
                     for i, res in enumerate(results):
@@ -1036,6 +1121,20 @@ class WorkersBackend:
                         self._turn = turn0 + k
                         self._record_alive(turn0 + k, total)
                     _ins.TURN_BATCH_SIZE.observe(k)
+                    if attribution:
+                        # per-addr StripStep walls + critical-path gating
+                        # (obs/critical.py) and the K-batch's dispatch-wall
+                        # decomposition — committed batches only, so a loss
+                        # retry never skews the attribution
+                        self._feed_critical(
+                            sink, plan.active, turn0 + k, k, strip=True
+                        )
+                        self._observe_segments(
+                            t_submitted - t_batch,
+                            t_gathered - t_submitted,
+                            time.monotonic() - t_gathered,
+                            sink,
+                        )
                 finally:
                     _tracing.end_span(turn_span)
                 # clean batches only, like the scatter loop; the EWMA unit

@@ -161,6 +161,13 @@ class Response:
     # (absent on a version-skewed or -integrity off peer's pickle — skew
     # degrades to "no attestation", never an AttributeError).
     digests: Optional[dict] = None
+    # extension: the worker-side handler wall of this reply's compute
+    # (Update / StripStep), in seconds — the broker's dispatch-wall
+    # decomposition subtracts it from the measured round trip to split
+    # wire time from worker compute (obs/perf.py, obs/critical.py).
+    # Readers use getattr: an older worker's pickle lacks it and 0.0
+    # degrades the split to "whole round trip counted as wire+compute".
+    service_seconds: float = 0.0
 
 
 # -- deserialisation allowlist ----------------------------------------------

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import argparse
 import threading
+import time
 
 import numpy as np
 
@@ -153,13 +154,20 @@ class WorkerService:
         # fail this worker's compute deterministically — one dict check when
         # unset. The broker's deadline/resplit/readmission paths are proven
         # against exactly this site (tests/test_chaos.py).
+        t0 = time.monotonic()
         _faults.fault_point("worker.update")
         world = np.asarray(req.world, np.uint8)
         if req.start_y == -1:  # haloed-strip wire mode
-            return Response(work_slice=compute_strip_haloed(world), worker=req.worker)
+            strip = compute_strip_haloed(world)
+        else:
+            strip = compute_strip(world, req.start_y, req.end_y)
+        # service_seconds includes any injected fault stall on purpose: a
+        # chaos-slowed worker must look slow to the broker's critical-path
+        # attribution, exactly like an organically slow one
         return Response(
-            work_slice=compute_strip(world, req.start_y, req.end_y),
+            work_slice=strip,
             worker=req.worker,
+            service_seconds=time.monotonic() - t0,
         )
 
     # -- resident-strip verbs (-wire resident: the strip stays here) --------
@@ -191,6 +199,7 @@ class WorkerService:
         the broker and this worker disagree about history (a stale worker
         readmitted mid-recovery) and MUST be an error reply, never a
         silently-diverged strip."""
+        t0 = time.monotonic()
         _faults.fault_point("worker.strip_step")
         k = req.turns
         with self._strip_lock:
@@ -259,6 +268,7 @@ class WorkerService:
                 edges=edges,
                 counts=counts,
                 digests=digests,
+                service_seconds=time.monotonic() - t0,
             )
 
     def strip_fetch(self, req: Request) -> Response:

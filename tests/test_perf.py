@@ -1,0 +1,585 @@
+"""Performance-attribution suite (ISSUE 12): the roofline classifier
+(obs/perf.py), the dispatch-wall decomposition, straggler/critical-path
+attribution (obs/critical.py), the doctor's straggler finding + incident
+bundle, the regress --latest selection fix, and the achieved-throughput
+regression gate."""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from gol_distributed_final_tpu.obs import critical as obs_critical
+from gol_distributed_final_tpu.obs import device as obs_device
+from gol_distributed_final_tpu.obs import metrics as obs_metrics
+from gol_distributed_final_tpu.obs import perf as obs_perf
+from gol_distributed_final_tpu.obs.status import series_map
+
+from helpers import REPO_ROOT
+
+
+@pytest.fixture
+def live_metrics():
+    obs_metrics.enable()
+    yield obs_metrics
+    obs_metrics.enable(False)
+
+
+@pytest.fixture
+def fresh_attribution():
+    """Reset the tracker, the calibration cache, and the attribution
+    switch around a test."""
+    obs_critical.tracker().reset()
+    obs_perf.reset_ceilings()
+    obs_perf.set_attribution(True)
+    yield
+    obs_critical.tracker().reset()
+    obs_perf.reset_ceilings()
+    obs_perf.set_attribution(True)
+
+
+def _ceilings(flops=1e12, bytes_per_s=1e11):
+    return obs_perf.Ceilings(
+        device_kind="test", flops_per_s=flops, bytes_per_s=bytes_per_s,
+        launch_seconds=5e-6, source="known",
+    )
+
+
+def _segment_count(component, segment):
+    snap = obs_metrics.registry().snapshot()
+    s = series_map(snap, "gol_turn_segment_seconds").get((component, segment))
+    return (s or {}).get("count") or 0
+
+
+# -- roofline classifier core -------------------------------------------------
+
+
+def test_classifier_three_classes():
+    ceil = _ceilings()
+    # dominant, substantial FLOP utilization
+    assert obs_perf.classify(8e11, 1e9, ceil)["bound_class"] == "compute-bound"
+    # dominant, substantial memory utilization
+    assert obs_perf.classify(1e10, 8e10, ceil)["bound_class"] == "memory-bound"
+    # far below BOTH ceilings: launch/issue latency is the residual
+    row = obs_perf.classify(1e9, 1e8, ceil)
+    assert row["bound_class"] == "launch-bound"
+    assert row["flops_utilization"] < obs_perf.LAUNCH_UTILIZATION
+    assert row["memory_utilization"] < obs_perf.LAUNCH_UTILIZATION
+
+
+def test_classifier_zero_flops_degenerate():
+    """A site whose cost analysis reported nothing must classify without
+    dividing by anything: zero/zero is launch-bound, zero flops with
+    real byte traffic is memory-bound."""
+    ceil = _ceilings()
+    assert obs_perf.classify(0.0, 0.0, ceil)["bound_class"] == "launch-bound"
+    assert obs_perf.classify(0.0, 9e10, ceil)["bound_class"] == "memory-bound"
+    # and a zero ceiling (broken calibration) must not raise either
+    broken = obs_perf.Ceilings("z", 0.0, 0.0, 0.0, "fitted")
+    assert obs_perf.classify(1e9, 1e9, broken)["bound_class"] == "launch-bound"
+
+
+def test_ceiling_calibration_cached(fresh_attribution):
+    """The microbench runs ON FIRST USE per device kind, then every later
+    call is a cache hit returning the same object."""
+    first = obs_perf.calibrate("weird-cpu-kind")
+    fits = obs_perf._FIT_RUNS
+    assert fits == 1 and first.source == "fitted"
+    again = obs_perf.calibrate("weird-cpu-kind")
+    assert again is first
+    assert obs_perf._FIT_RUNS == fits  # no second microbench
+    # a KNOWN TPU kind never pays the microbench at all
+    v5e = obs_perf.calibrate("TPU v5e")
+    assert v5e.source == "known" and obs_perf._FIT_RUNS == fits
+    assert v5e.flops_per_s > 1e13 and v5e.bytes_per_s > 1e11
+
+
+def test_bench_round_classification_pin(fresh_attribution):
+    """The acceptance pin on this repo's own bench data: against v5e
+    ceilings, the 128² floor case classifies launch-bound and the
+    4096²+ dense cases classify NON-launch-bound."""
+    ceil = obs_perf.calibrate("v5e")
+    rows = obs_perf.rows_from_bench(REPO_ROOT / "BENCH_r04.json", ceil)
+    by_case = {r["case"]: r for r in rows}
+    assert by_case["c2_128_pallas_bitboard"]["bound_class"] == "launch-bound"
+    for case in (
+        "c4_4096_tiled_bitboard",
+        "c5_16384_sparse_bigboard",
+        "c5_65536_sparse_bigboard",
+    ):
+        assert by_case[case]["bound_class"] != "launch-bound", case
+    # embedded roofline fields (bench.py from this PR on) take precedence
+    # over the name-parsed model
+    fake = {"cases": {"c2_128_x": {
+        "per_turn_us": 1.0, "achieved_flops": 5.0,
+        "achieved_bytes_per_s": 7.0, "bound_class": "memory-bound",
+    }}, "provenance": None, "salvaged": False, "label": "x"}
+    import gol_distributed_final_tpu.obs.regress as regress
+
+    orig = regress.load_bench
+    regress.load_bench = lambda _p: fake
+    try:
+        rows = obs_perf.rows_from_bench("whatever.json", ceil)
+    finally:
+        regress.load_bench = orig
+    assert rows[0]["achieved_flops"] == 5.0
+    assert rows[0]["bound_class"] == "memory-bound"
+
+
+def test_dispatch_stats_and_refresh(live_metrics, fresh_attribution):
+    """The roofline join end to end in-process: an instrumented jitted
+    call records its dispatch wall + program cost exactly once per call,
+    and refresh_metrics publishes achieved gauges + ONE bound class."""
+    import jax
+    import jax.numpy as jnp
+
+    obs_device.reset_dispatch()
+    jitted = jax.jit(lambda x: x * 2 + 1)
+    wrapped = obs_device.instrument_jit("perf.test_site", jitted)
+    x = jnp.ones((33, 17), jnp.float32)  # unique signature for this test
+    for _ in range(3):
+        np.asarray(wrapped(x))
+    stats = obs_device.dispatch_stats()
+    assert stats["perf.test_site"]["calls"] == 3
+    assert stats["perf.test_site"]["wall_s"] > 0
+    rows = obs_perf.refresh_metrics(_ceilings())
+    row = next(r for r in rows if r["site"] == "perf.test_site")
+    assert row["bound_class"] in obs_perf.BOUND_CLASSES
+    snap = obs_metrics.registry().snapshot()
+    achieved = series_map(snap, "gol_kernel_achieved_flops")
+    assert ("perf.test_site",) in achieved
+    bound = series_map(snap, "gol_kernel_bound")
+    on = [
+        labels for labels, s in bound.items()
+        if labels[0] == "perf.test_site" and s.get("value")
+    ]
+    assert len(on) == 1 and on[0][1] == row["bound_class"]
+
+
+# -- dispatch-wall decomposition ----------------------------------------------
+
+
+def test_engine_decomposition_segments(live_metrics, fresh_attribution):
+    from gol_distributed_final_tpu.engine.engine import Engine, EngineConfig
+    from gol_distributed_final_tpu.params import Params
+
+    before = {
+        seg: _segment_count("engine", seg)
+        for seg in ("host_prep", "device_compute", "demux")
+    }
+    rng = np.random.default_rng(5)
+    board = np.where(rng.random((32, 32)) < 0.3, 255, 0).astype(np.uint8)
+    Engine(EngineConfig(min_chunk=1, max_chunk=4)).run(
+        Params(turns=8, image_width=32, image_height=32), board
+    )
+    for seg, prev in before.items():
+        assert _segment_count("engine", seg) > prev, seg
+    decomp = obs_perf.decomposition_summary()
+    assert "engine" in decomp
+    segs = decomp["engine"]
+    assert segs["_total_s"] > 0
+    assert abs(sum(
+        e["share"] for k, e in segs.items() if isinstance(e, dict)
+    ) - 1.0) < 0.01
+
+
+def test_sessions_decomposition_segments(live_metrics, fresh_attribution):
+    from gol_distributed_final_tpu.engine.sessions import SessionTable
+    from gol_distributed_final_tpu.models import CONWAY
+
+    before = _segment_count("sessions", "device_compute")
+    rng = np.random.default_rng(6)
+    boards = np.where(rng.random((3, 16, 16)) < 0.3, 255, 0).astype(np.uint8)
+    table = SessionTable(CONWAY, (16, 16), capacity=4)
+    for i in range(3):
+        table.admit(boards[i], 4)
+    while table.advance():
+        pass
+    assert _segment_count("sessions", "device_compute") > before
+    assert _segment_count("sessions", "demux") > 0
+
+
+def test_attribution_switch_disables_segments(live_metrics, fresh_attribution):
+    from gol_distributed_final_tpu.engine.engine import Engine, EngineConfig
+    from gol_distributed_final_tpu.params import Params
+
+    obs_perf.set_attribution(False)
+    before = _segment_count("engine", "device_compute")
+    board = np.zeros((16, 16), np.uint8)
+    Engine(EngineConfig(min_chunk=1, max_chunk=2)).run(
+        Params(turns=4, image_width=16, image_height=16), board
+    )
+    assert _segment_count("engine", "device_compute") == before
+
+
+# -- straggler / critical-path attribution ------------------------------------
+
+_MATRIX = [
+    {":8030": 0.010, ":8031": 0.012, ":8032": 0.055, ":8033": 0.011}
+    for _ in range(4)
+]
+
+
+def test_tracker_attributes_fake_matrix(fresh_attribution):
+    cp = obs_critical.attribute_batches(_MATRIX)
+    assert cp["batches"] == 4
+    s = cp["straggler"]
+    assert s and s["addr"] == ":8032"
+    assert s["gated_share"] == 1.0
+    assert s["skew"] > obs_critical.STRAGGLER_SKEW_RATIO
+    rows = {w["addr"]: w for w in cp["workers"]}
+    assert rows[":8030"]["gated"] == 0 and rows[":8032"]["gated"] == 4
+    assert rows[":8032"]["calls"] == 4
+
+
+def test_tracker_balanced_roster_names_nobody(fresh_attribution):
+    balanced = [
+        {":8030": 0.010, ":8031": 0.011, ":8032": 0.012, ":8033": 0.010}
+        for _ in range(6)
+    ]
+    cp = obs_critical.attribute_batches(balanced)
+    assert cp["straggler"] is None
+    assert cp["skew_ratio"] < obs_critical.STRAGGLER_SKEW_RATIO
+
+
+def test_tracker_sets_skew_gauge_and_service_preference(
+    live_metrics, fresh_attribution
+):
+    t = obs_critical.tracker()
+    # service time preferred over round trip when the reply carried it:
+    # a slow WIRE to a fast worker must not skew its service EWMA
+    t.record_batch([(":a", 0.050, 0.001), (":b", 0.010, 0.009)])
+    cp = t.snapshot()
+    rows = {w["addr"]: w for w in cp["workers"]}
+    assert rows[":a"]["ewma_s"] == pytest.approx(0.001)
+    # ...but the GATING attribution stays on the round trip (the gather
+    # completed at :a regardless of where the time went)
+    assert rows[":a"]["gated"] == 1
+    snap = obs_metrics.registry().snapshot()
+    g = series_map(snap, "gol_worker_skew_ratio").get(())
+    assert g and g.get("value") > 0
+
+
+def test_doctor_straggler_finding_canned(fresh_attribution):
+    from gol_distributed_final_tpu.obs.doctor import diagnose
+
+    cp = obs_critical.attribute_batches(_MATRIX)
+    statuses = {
+        "broker 127.0.0.1:1": {
+            "pid": 1, "metrics_enabled": True, "metrics": {},
+            "critical_path": cp,
+        }
+    }
+    findings = diagnose(statuses)
+    top = findings[0]
+    assert "straggler" in top["title"]
+    assert ":8032" in top["suspects"]
+    assert any(":8030" in e for e in top["evidence"])  # per-addr evidence
+    # a healthy payload must NOT produce the finding
+    healthy = {
+        "broker 127.0.0.1:1": {
+            "pid": 1, "metrics_enabled": True, "metrics": {},
+        }
+    }
+    assert all("straggler" not in f["title"] for f in diagnose(healthy))
+
+
+def test_worker_skew_rule_in_default_book():
+    from gol_distributed_final_tpu.obs.slo import (
+        DEFAULT_RULE_NAMES,
+        default_rules,
+    )
+
+    assert "worker-skew" in DEFAULT_RULE_NAMES
+    rule = next(r for r in default_rules() if r.name == "worker-skew")
+    assert rule.metric == "gol_worker_skew_ratio"
+
+
+# -- live slow worker: the doctor names it ------------------------------------
+
+
+def _spawn_worker(extra_env=None):
+    env = dict(os.environ)
+    env.pop("GOL_FAULT_POINTS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    if extra_env:
+        env.update(extra_env)
+    return subprocess.Popen(
+        [sys.executable, "-m", "gol_distributed_final_tpu.rpc.worker",
+         "-port", "0"],
+        cwd=REPO_ROOT, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+
+
+def _wait_port(proc, timeout=60):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if "listening on :" in line:
+            return int(line.rsplit(":", 1)[1].split()[0])
+        if proc.poll() is not None:
+            raise RuntimeError(f"worker died: {proc.stdout.read()}")
+    raise TimeoutError("worker did not report listening")
+
+
+def test_live_slow_worker_named_by_doctor(live_metrics, fresh_attribution):
+    """A sleep-injected slow worker (GOL_FAULT_POINTS on its update /
+    strip_step sites) in a live 4-worker resident cluster: the broker's
+    critical-path attribution gates on it from the FIRST K-batch, and
+    the doctor's top finding names it with per-address service-time
+    evidence."""
+    from gol_distributed_final_tpu.obs.doctor import collect, diagnose, render
+    from gol_distributed_final_tpu.rpc.broker import serve
+    from gol_distributed_final_tpu.rpc.client import RpcClient
+    from gol_distributed_final_tpu.rpc.protocol import Methods, Request
+
+    obs_metrics.registry().reset()  # other modules' counters must not
+    # outrank the straggler in the shared-process registry
+    slow_env = {
+        "GOL_FAULT_POINTS":
+            "worker.strip_step:sleep:1:0.08,worker.update:sleep:1:0.08"
+    }
+    workers = [_spawn_worker(slow_env if i == 0 else None) for i in range(4)]
+    server = None
+    try:
+        ports = [_wait_port(w) for w in workers]
+        slow_addr = f"127.0.0.1:{ports[0]}"
+        server, service = serve(
+            port=0, backend="workers",
+            worker_addresses=[f"127.0.0.1:{p}" for p in ports],
+            wire="resident", halo_depth=4,
+        )
+        addr = f"127.0.0.1:{server.port}"
+        rng = np.random.default_rng(9)
+        board = np.where(rng.random((64, 64)) < 0.3, 255, 0).astype(np.uint8)
+        client = RpcClient(addr)
+        try:
+            client.call(
+                Methods.BROKER_RUN,
+                Request(world=board, turns=12, threads=4,
+                        image_width=64, image_height=64),
+                timeout=120.0,
+            )
+        finally:
+            client.close()
+        cp = obs_critical.tracker().snapshot()
+        assert cp["batches"] >= 1
+        s = cp["straggler"]
+        assert s and s["addr"] == slow_addr, cp
+        # per-addr StripStep service-time histogram recorded broker-side
+        snap = obs_metrics.registry().snapshot()
+        strips = series_map(snap, "gol_strip_step_seconds")
+        assert (slow_addr,) in strips and strips[(slow_addr,)]["count"] >= 1
+        # the doctor, over the real read-only Status surface
+        statuses = collect(addr, [])
+        findings = diagnose(statuses)
+        top = findings[0]
+        assert "straggler" in top["title"], [f["title"] for f in findings]
+        assert slow_addr in top["suspects"]
+        assert render(findings, statuses).strip()
+        # the broker also decomposed its batches: wire + compute segments
+        assert _segment_count("broker", "device_compute") >= 1
+        assert _segment_count("broker", "wire") >= 1
+    finally:
+        if server is not None:
+            service.backend.close()
+            server.stop()
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+            w.wait()
+
+
+# -- regress: --latest selection + achieved-throughput gate -------------------
+
+
+def test_latest_bench_files_ignores_non_rounds(tmp_path):
+    from gol_distributed_final_tpu.obs.regress import latest_bench_files
+
+    for name in (
+        "BENCH_r01.json", "BENCH_r02.json", "BENCH_r10.json",
+        "MULTICHIP_r03.json", "MULTICHIP_r11.json", "BENCH_rX.json",
+        "BENCH_r05.json.tmp",
+    ):
+        (tmp_path / name).write_text("{}")
+    rounds = [p.name for p in latest_bench_files(tmp_path)]
+    # strictly BENCH_r<number>.json, numerically ordered (r10 after r02)
+    assert rounds == ["BENCH_r01.json", "BENCH_r02.json", "BENCH_r10.json"]
+
+
+def test_latest_cli_skips_junk_rounds(tmp_path, capsys):
+    """--latest over a directory whose only *_r*.json files are junk
+    must be a clean no-op, not a load error on MULTICHIP data."""
+    from gol_distributed_final_tpu.obs.regress import main
+
+    (tmp_path / "MULTICHIP_r01.json").write_text('{"n_devices": 8}')
+    (tmp_path / "MULTICHIP_r02.json").write_text('{"n_devices": 8}')
+    assert main(["--latest", "--dir", str(tmp_path)]) == 0
+    assert "fewer than two" in capsys.readouterr().err
+
+
+def _case(us, flops=None, cls=None):
+    out = {
+        "per_turn_us": us, "spread_s": 0.00001, "n_lo": 100, "n_hi": 1100,
+    }
+    if flops is not None:
+        out["achieved_flops"] = flops
+    if cls is not None:
+        out["bound_class"] = cls
+    return out
+
+
+def test_regress_gates_achieved_throughput():
+    from gol_distributed_final_tpu.obs.regress import compare_case
+
+    # big achieved-FLOP/s drop past threshold + noise: REGRESSED with the
+    # roofline why, even though wall-clock alone would already flag it
+    v = compare_case(
+        _case(1.0, flops=1e12, cls="memory-bound"),
+        _case(2.0, flops=5e11, cls="launch-bound"),
+        threshold=0.05, noise_k=2.0,
+    )
+    assert v["verdict"] == "REGRESSED"
+    assert v["bound_class_change"] == "memory-bound -> launch-bound"
+    assert v["achieved_delta_pct"] == pytest.approx(-50.0)
+    # drop inside the noise band: never gated by the roofline fields
+    v = compare_case(
+        _case(1.0, flops=1.00e12), _case(1.001, flops=0.999e12),
+        threshold=0.05, noise_k=2.0,
+    )
+    assert v["verdict"] == "jitter"
+    # an achieved drop must gate even when wall-clock is unusable
+    # (salvaged fragment): the incomparable verdict upgrades
+    broken_old = {"per_turn_us": 0, "achieved_flops": 1e12}
+    broken_new = {"per_turn_us": 0, "achieved_flops": 1e11}
+    v = compare_case(broken_old, broken_new, threshold=0.05)
+    assert v["verdict"] == "REGRESSED"
+    assert "achieved" in v["why"]
+
+
+# -- watch panels + report embeds ---------------------------------------------
+
+
+def test_watch_renders_attribution_panels(live_metrics, fresh_attribution):
+    from gol_distributed_final_tpu.obs.watch import render_status
+
+    for seg, dt in (
+        ("host_prep", 0.01), ("device_compute", 0.2),
+        ("wire", 0.05), ("demux", 0.02),
+    ):
+        import gol_distributed_final_tpu.obs.instruments as ins
+
+        ins.TURN_SEGMENT_SECONDS.labels("broker", seg).observe(dt)
+    import gol_distributed_final_tpu.obs.instruments as ins
+
+    ins.KERNEL_ACHIEVED_FLOPS.labels("pallas.vmem_bit").set(2e11)
+    ins.KERNEL_ACHIEVED_BYTES.labels("pallas.vmem_bit").set(4e10)
+    ins.KERNEL_BOUND.labels("pallas.vmem_bit", "launch-bound").set(1)
+    cp = obs_critical.attribute_batches(_MATRIX)
+    payload = {
+        "role": "broker", "pid": 1, "metrics_enabled": True,
+        "metrics": obs_metrics.registry().snapshot(),
+        "critical_path": cp,
+    }
+    frame = render_status("broker :8040", payload)
+    assert "WHERE TIME GOES" in frame
+    assert "device_compute" in frame and "wire" in frame
+    assert "CRITICAL PATH" in frame
+    assert "STRAGGLER :8032" in frame
+    assert "ROOFLINE" in frame and "launch-bound" in frame
+
+
+def test_report_embeds_attribution(live_metrics, fresh_attribution, tmp_path):
+    from gol_distributed_final_tpu.obs.report import write_run_report
+    from gol_distributed_final_tpu.params import Params
+
+    import gol_distributed_final_tpu.obs.instruments as ins
+
+    ins.TURN_SEGMENT_SECONDS.labels("engine", "device_compute").observe(0.5)
+    ins.TURN_SEGMENT_SECONDS.labels("engine", "demux").observe(0.1)
+    obs_critical.tracker().record_batch([(":a", 0.02, None), (":b", 0.01, None)])
+    path = write_run_report(
+        Params(turns=4, image_width=16, image_height=16), tmp_path
+    )
+    report = json.loads(path.read_text())
+    assert "where_time_goes" in report
+    assert report["where_time_goes"]["engine"]["device_compute"]["count"] >= 1
+    assert report["critical_path"]["batches"] >= 1
+
+
+def test_status_payload_ships_critical_path(live_metrics, fresh_attribution):
+    from gol_distributed_final_tpu.obs.report import status_payload
+
+    assert "critical_path" not in status_payload(role="broker")
+    obs_critical.tracker().record_batch([(":a", 0.02, None), (":b", 0.01, None)])
+    payload = status_payload(role="broker")
+    assert payload["critical_path"]["batches"] == 1
+
+
+# -- doctor bundle ------------------------------------------------------------
+
+
+def test_doctor_bundle_collects_artifacts(tmp_path):
+    from gol_distributed_final_tpu.obs.doctor import write_bundle
+
+    out = tmp_path / "out"
+    out.mkdir()
+    (out / "trace_64x64x8.json").write_text("[]")
+    (out / "flight_host.jsonl").write_text("{}\n")
+    (out / "report_16x16x4.json").write_text("{}")
+    (out / "analysis.json").write_text("{}")
+    statuses = {
+        "broker 127.0.0.1:1": {
+            "pid": 1, "metrics": {}, "timeline": {"seq": 3},
+            "accounting": {"tenants": []}, "flight": [],
+        },
+        "worker 127.0.0.1:2": {"error": "no status: dead"},
+    }
+    findings = [{"severity": "warn", "title": "t", "rank": 1}]
+    bdir = write_bundle(findings, statuses, out)
+    assert bdir.parent == out and bdir.name.startswith("bundle_")
+    manifest = json.loads((bdir / "manifest.json").read_text())
+    names = {e["file"] for e in manifest["entries"]}
+    # diagnosis + one full status per target + the on-disk artifacts
+    assert "doctor.json" in names
+    assert any(n.startswith("status_broker") for n in names)
+    assert any(n.startswith("status_worker") for n in names)
+    for artifact in (
+        "trace_64x64x8.json", "flight_host.jsonl",
+        "report_16x16x4.json", "analysis.json",
+    ):
+        assert artifact in names and (bdir / artifact).exists()
+    # the full status payload (timeline + accounting evidence) is IN the
+    # bundle, not a trimmed identity stub
+    status_file = next(n for n in names if n.startswith("status_broker"))
+    payload = json.loads((bdir / status_file).read_text())
+    assert payload["timeline"] == {"seq": 3}
+    assert manifest["targets"] == sorted(statuses)
+
+
+# -- lint + selfchecks --------------------------------------------------------
+
+
+def test_perf_lint_both_ways(tmp_path):
+    from gol_distributed_final_tpu.obs.lint import (
+        missing_readme_sections,
+        undocumented_perf_names,
+    )
+
+    assert undocumented_perf_names() == []  # the shipped README documents all
+    assert "## Performance attribution" not in missing_readme_sections()
+    bad = tmp_path / "README.md"
+    bad.write_text("# nothing\n\n## Performance attribution\n\nonly prose\n")
+    missing = undocumented_perf_names(bad)
+    assert "gol_kernel_bound" in missing and "launch-bound" in missing
+
+
+def test_critical_selfcheck_passes(capsys):
+    assert obs_critical._selfcheck() == 0
+    assert "straggler attribution exact" in capsys.readouterr().out
