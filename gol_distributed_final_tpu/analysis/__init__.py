@@ -19,16 +19,26 @@ mandatory and machine-enforced, so the allow-list stays auditable).
 
 Layout:
 
-* ``core.py``    — Finding/Checker framework, file walker, suppressions,
-  the runner and its exit-code contract
+* ``core.py``    — Finding/Checker framework, file walker, suppressions
+  (with staleness tracking), the runner and its exit-code contract
 * ``skew.py``    — ``skew-safety``: getattr/.get discipline on wire objects
 * ``locks.py``   — ``lock-discipline``: ``_GUARDED_BY`` field/lock contracts
+* ``lockorder.py``— whole-program lock composition: ``lock-order``
+  acquisition-graph cycles, ``atomicity`` read-release-write TOCTOU,
+  ``blocking-under-lock`` blocking calls under hot-path locks
 * ``jit.py``     — ``jit-cache``: quantised static kernel args, pure kernels
-* ``hygiene.py`` — ``hygiene``: daemonised/joined threads, no silent excepts
+* ``hygiene.py`` — ``hygiene``: daemonised/joined threads, context-managed
+  executors, no silent excepts
 * ``lints.py``   — the obs/lint.py README name-drift lints, re-seated as
   repo-level checkers (one runner, one finding format, one suppression
   syntax)
 * ``__main__.py``— the CLI: ``python -m gol_distributed_final_tpu.analysis``
+
+The static layer's runtime twin is ``utils/locksan.py``: ``GOL_LOCKSAN=1``
+swaps the instrumented classes' locks for order-recording wrappers that
+abort on an observed inversion and watchdog long holds — what the AST
+cannot resolve (dynamic dispatch, module-attribute objects), the
+sanitizer observes live under ``scripts/check --locksan``.
 """
 
 from __future__ import annotations
@@ -40,15 +50,27 @@ def ast_checkers():
     """The per-file AST checkers, stable order."""
     from .hygiene import HygieneChecker
     from .jit import JitCacheChecker
+    from .lockorder import AtomicityChecker
     from .locks import LockDisciplineChecker
     from .skew import SkewSafetyChecker
 
     return [
         SkewSafetyChecker(),
         LockDisciplineChecker(),
+        AtomicityChecker(),
         JitCacheChecker(),
         HygieneChecker(),
     ]
+
+
+def concurrency_checkers():
+    """The repo-level lock-composition checkers (lockorder.py): these
+    are INVARIANT checkers like the AST set — ``--no-lint`` keeps them —
+    but they need the whole tree (the acquisition graph spans modules),
+    so they run through ``check_tree``."""
+    from .lockorder import concurrency_repo_checkers
+
+    return concurrency_repo_checkers()
 
 
 def repo_checkers():
@@ -59,4 +81,4 @@ def repo_checkers():
 
 
 def all_checkers():
-    return ast_checkers() + repo_checkers()
+    return ast_checkers() + concurrency_checkers() + repo_checkers()

@@ -17,7 +17,7 @@ import json
 import pathlib
 import sys
 
-from . import all_checkers, ast_checkers
+from . import all_checkers, ast_checkers, concurrency_checkers
 from .core import run
 
 PACKAGE_ROOT = pathlib.Path(__file__).resolve().parent.parent
@@ -46,7 +46,8 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "--no-lint", action="store_true",
-        help="AST checkers only (skip the repo-level README lints)",
+        help="invariant checkers only (AST + lock-composition; skip the "
+        "repo-level README lints)",
     )
     parser.add_argument(
         "--list", action="store_true", dest="list_checks",
@@ -54,7 +55,10 @@ def main(argv=None) -> int:
     )
     args = parser.parse_args(argv)
 
-    checkers = ast_checkers() if args.no_lint else all_checkers()
+    checkers = (
+        ast_checkers() + concurrency_checkers()
+        if args.no_lint else all_checkers()
+    )
     if args.checks:
         wanted = {s.strip() for s in args.checks.split(",") if s.strip()}
         unknown = wanted - {c.id for c in checkers}
@@ -69,7 +73,11 @@ def main(argv=None) -> int:
     root = pathlib.Path(args.path) if args.path else PACKAGE_ROOT
     if not root.exists():
         parser.error(f"no such path: {root}")
-    report = run(root, checkers=checkers, with_repo=not args.no_lint)
+    # with_repo stays True under --no-lint: the lock-composition
+    # checkers are repo-LEVEL (the graph spans modules) but they are
+    # invariant checkers, not doc lints — the flag excluded the README
+    # lints from `checkers` above, which is all it promises
+    report = run(root, checkers=checkers, with_repo=True)
     if args.as_json:
         blob = json.dumps(report.to_json(), indent=1)
         print(blob)

@@ -15,7 +15,11 @@ Contracts this module owns:
   immediately above it. The justification is MANDATORY: an allow comment
   without one (or naming an unknown check id) is itself a
   ``suppression-format`` finding, so the allow-list can never silently
-  rot into an unexplained mute button.
+  rot into an unexplained mute button. And it must stay LIVE: an allow
+  that hid nothing across a full-registry run is a
+  ``suppression-stale`` finding — when the code it excused (or the
+  checker it named) changes, the audit trail shrinks instead of
+  fossilising.
 * **Walker** — every ``*.py`` under the root, skipping ``native/`` and
   other non-source trees (``SKIP_DIR_NAMES``) and files that declare
   themselves generated. A file that cannot be PARSED is a loud
@@ -41,6 +45,7 @@ from typing import Iterable, List, Optional, Sequence, Tuple
 #: framework-owned check ids (not suppressible via themselves)
 CHECK_PARSE = "parse-failure"
 CHECK_SUPPRESSION = "suppression-format"
+CHECK_STALE = "suppression-stale"
 
 #: directory names the walker never descends into: native build trees,
 #: caches, artifact dirs — nothing in them is first-party Python source
@@ -112,11 +117,21 @@ class Suppressions:
     suppresses the next statement that holds code (so a long flagged
     line can carry its justification above itself). Format problems —
     no justification, no/unknown check id — surface as
-    ``suppression-format`` findings in ``problems``."""
+    ``suppression-format`` findings in ``problems``.
+
+    Every well-formed allow additionally tracks whether it HID anything:
+    one that matched no finding across a full-registry run is reported
+    as ``suppression-stale`` (:meth:`stale_findings`), so the allow-list
+    cannot rot as checkers and code evolve — a suppression for a bug
+    long since fixed (or a checker long since changed) is itself a
+    finding, not a silent permanent mute."""
 
     def __init__(self, source: str, relpath: str, known_ids, tree=None):
+        self.relpath = relpath
         self.by_line: dict = {}
         self.problems: List[Finding] = []
+        #: each well-formed allow: {"line", "ids", "used", "malformed"}
+        self.allows: List[dict] = []
         known = frozenset(known_ids)
         lines = source.splitlines()
         spans = self._statement_spans(tree)
@@ -136,22 +151,32 @@ class Suppressions:
                 ):
                     j += 1
                 target = j
+            malformed = False
             if not ids:
+                malformed = True
                 self.problems.append(Finding(
                     CHECK_SUPPRESSION, relpath, i,
                     "allow() names no check id",
                 ))
             for unknown in (x for x in ids if x not in known):
+                malformed = True
                 self.problems.append(Finding(
                     CHECK_SUPPRESSION, relpath, i,
                     f"allow() names unknown check id {unknown!r}",
                 ))
             if not justification:
+                malformed = True
                 self.problems.append(Finding(
                     CHECK_SUPPRESSION, relpath, i,
                     "suppression carries no justification — write "
                     "'# gol: allow(<check>): <why this is safe>'",
                 ))
+            allow = {
+                "line": i, "ids": tuple(ids), "used": set(),
+                "malformed": malformed,
+            }
+            self.allows.append(allow)
+            index = len(self.allows) - 1
             # record the suppression even when malformed: the format
             # finding above already fails the run, and double-reporting
             # the underlying finding would bury it — and expand it over
@@ -159,7 +184,9 @@ class Suppressions:
             # anchored at a multi-line statement's FIRST line are hidden
             # by an allow on its LAST
             for line in spans.get(target, (target,)):
-                self.by_line.setdefault(line, set()).update(ids)
+                slot = self.by_line.setdefault(line, {})
+                for check_id in ids:
+                    slot.setdefault(check_id, index)
 
     @staticmethod
     def _statement_spans(tree) -> dict:
@@ -208,7 +235,34 @@ class Suppressions:
             return []
 
     def hides(self, finding: Finding) -> bool:
-        return finding.check in self.by_line.get(finding.line, ())
+        slot = self.by_line.get(finding.line, {})
+        index = slot.get(finding.check)
+        if index is None:
+            return False
+        self.allows[index]["used"].add(finding.check)
+        return True
+
+    def stale_findings(self) -> List[Finding]:
+        """One ``suppression-stale`` finding per well-formed allow whose
+        named check(s) hid NOTHING. Malformed allows are exempt — their
+        format finding already fails the run; double-reporting would
+        bury it. Callers run this only after EVERY checker in the full
+        registry has reported (a filtered ``--checks`` run proves
+        nothing about the other checkers' suppressions)."""
+        stale = []
+        for allow in self.allows:
+            if allow["malformed"]:
+                continue
+            unmatched = [c for c in allow["ids"] if c not in allow["used"]]
+            if unmatched:
+                stale.append(Finding(
+                    CHECK_STALE, self.relpath, allow["line"],
+                    f"allow({', '.join(unmatched)}) matched no finding in "
+                    f"a full-registry run — the code it excused has "
+                    f"changed (or the checker has); delete the "
+                    f"suppression or re-justify what it covers",
+                ))
+        return stale
 
 
 @dataclasses.dataclass
@@ -305,6 +359,21 @@ def analyze_source(
     """Run the file checkers over one source blob —
     ``(findings, suppressed)``. The test fixture corpus drives each
     checker through exactly this entry point."""
+    findings, suppressed, _sup = _analyze_file(
+        source, relpath, checkers, known_ids
+    )
+    return findings, suppressed
+
+
+def _analyze_file(
+    source: str,
+    relpath: str,
+    checkers: Sequence[Checker],
+    known_ids: Optional[Iterable[str]] = None,
+) -> Tuple[List[Finding], List[Finding], Optional[Suppressions]]:
+    """:func:`analyze_source` plus the file's :class:`Suppressions` (for
+    the runner: repo-checker findings route through it, and the stale
+    pass interrogates it after every checker has reported)."""
     if known_ids is None:
         known_ids = [c.id for c in checkers]
     try:
@@ -315,7 +384,7 @@ def analyze_source(
             CHECK_PARSE, relpath, line,
             f"cannot parse: {getattr(e, 'msg', e)} — the analyzer refuses "
             "to silently skip unreadable source",
-        )], []
+        )], [], None
     sup = Suppressions(source, relpath, known_ids, tree=tree)
     findings: List[Finding] = list(sup.problems)
     suppressed: List[Finding] = []
@@ -326,7 +395,7 @@ def analyze_source(
                 continue  # e.g. two reads of one field on one line
             seen.add(f)
             (suppressed if sup.hides(f) else findings).append(f)
-    return findings, suppressed
+    return findings, suppressed, sup
 
 
 def run(
@@ -352,12 +421,13 @@ def run(
     ]
     # suppressions validate against the FULL registry, not just this
     # run's (possibly --checks-filtered) subset: an in-tree
-    # '# gol: allow(hygiene): ...' must stay a known id during a
+    # 'gol: allow(hygiene): ...' comment must stay a known id during a
     # --checks jit-cache run, not become a spurious format finding
     known_ids = {c.id for c in checkers} | {c.id for c in all_checkers()}
     base = rel_base(root)
     findings: List[Finding] = []
     suppressed: List[Finding] = []
+    sups: dict = {}  # relpath -> Suppressions, for the passes below
     files = 0
     for path in iter_python_files(root):
         try:
@@ -376,10 +446,30 @@ def run(
             continue
         files += 1
         relpath = path.relative_to(base).as_posix()
-        got, hidden = analyze_source(source, relpath, file_checkers, known_ids)
+        got, hidden, sup = _analyze_file(
+            source, relpath, file_checkers, known_ids
+        )
         findings.extend(got)
         suppressed.extend(hidden)
+        if sup is not None:
+            sups[relpath] = sup
     if with_repo:
         for checker in repo:
-            findings.extend(checker.check_tree(root))
+            for f in checker.check_tree(root):
+                # repo-level findings anchored in a source file (the
+                # lock-composition checkers) honor that file's inline
+                # allows like any per-file finding; README-anchored doc
+                # lints have no suppression surface, as before
+                sup = sups.get(f.path)
+                if sup is not None and sup.hides(f):
+                    suppressed.append(f)
+                else:
+                    findings.append(f)
+    # the stale pass LAST, and only when this run exercised the full
+    # registry (plus the repo checkers): a filtered run proves nothing
+    # about the other checkers' suppressions and must not flag them
+    full = {c.id for c in all_checkers()} <= {c.id for c in checkers}
+    if full and with_repo:
+        for relpath in sorted(sups):
+            findings.extend(sups[relpath].stale_findings())
     return Report(findings, suppressed, files, list(checkers))

@@ -16,6 +16,14 @@ Two resource-lifecycle contracts:
    layers exist precisely because silent failure is the worst failure
    mode; a handler that narrows the type, logs, flight-records,
    re-raises, or returns a sentinel all pass.
+
+3. **Executors.** A ``ThreadPoolExecutor``/``ProcessPoolExecutor`` must
+   be context-managed (``with ...Executor(...) as pool``) or have a
+   provable in-file ``shutdown`` call on its bound name, same
+   owning-scope rule as the thread join proof. A leaked pool is the
+   thread leak multiplied by its worker count — the RpcClient-pool
+   class of bug: the broker's scatter pool outliving its run wedges
+   shutdown exactly like one un-joined thread, times ``pool_size``.
 """
 
 from __future__ import annotations
@@ -26,6 +34,7 @@ from typing import Iterable, List, Set
 from .core import Checker, Finding
 
 _THREAD_FACTORIES = frozenset({"Thread", "Timer"})
+_EXECUTOR_FACTORIES = frozenset({"ThreadPoolExecutor", "ProcessPoolExecutor"})
 _BROAD = frozenset({"Exception", "BaseException"})
 
 
@@ -38,6 +47,15 @@ def _is_thread_call(node: ast.Call) -> bool:
             and func.value.id == "threading"
         )
     return isinstance(func, ast.Name) and func.id in _THREAD_FACTORIES
+
+
+def _is_executor_call(node: ast.Call) -> bool:
+    # bare name, or any dotted form ending in the factory
+    # (concurrent.futures.ThreadPoolExecutor, futures.ThreadPoolExecutor)
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr in _EXECUTOR_FACTORIES
+    return isinstance(func, ast.Name) and func.id in _EXECUTOR_FACTORIES
 
 
 def _target_name(target) -> str:
@@ -68,18 +86,19 @@ def _broad_type(handler: ast.ExceptHandler) -> bool:
 class HygieneChecker(Checker):
     id = "hygiene"
     description = (
-        "threads are daemon=True or joined in-file; broad except "
-        "handlers log/flight-record/raise/return instead of silently "
-        "swallowing"
+        "threads are daemon=True or joined in-file; executors are "
+        "context-managed or shut down in-file; broad except handlers "
+        "log/flight-record/raise/return instead of silently swallowing"
     )
     bug_class = (
-        "leaked threads wedging process shutdown; failures vanishing "
-        "with no log, flight event, or propagation"
+        "leaked threads/pools wedging process shutdown; failures "
+        "vanishing with no log, flight event, or propagation"
     )
 
     def check_file(self, tree, source, relpath) -> Iterable[Finding]:
         findings: List[Finding] = []
         self._check_threads(tree, relpath, findings)
+        self._check_executors(tree, relpath, findings)
         self._check_excepts(tree, relpath, findings)
         return findings
 
@@ -163,6 +182,79 @@ class HygieneChecker(Checker):
                 f"process shutdown",
             ))
 
+    # -- executors -----------------------------------------------------------
+
+    def _check_executors(self, tree, relpath, findings) -> None:
+        """Executor discipline mirrors the thread rule: context-managed
+        (``with`` owns the shutdown) or a ``shutdown`` call on the bound
+        name in its owning scope — class scope for ``self.X``, function
+        scope for locals."""
+        parents = {
+            child: parent
+            for parent in ast.walk(tree)
+            for child in ast.iter_child_nodes(parent)
+        }
+
+        def enclosing(node, kinds):
+            cur = parents.get(node)
+            while cur is not None and not isinstance(cur, kinds):
+                cur = parents.get(cur)
+            return cur if cur is not None else tree
+
+        bound: dict = {}  # id(call node) -> bound name
+        managed: set = set()  # id(call node) of with-managed executors
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                value = getattr(node, "value", None)
+                if isinstance(value, ast.Call) and _is_executor_call(value):
+                    targets = (
+                        node.targets
+                        if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for t in targets:
+                        name = _target_name(t)
+                        if name:
+                            bound[id(value)] = name
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if isinstance(item.context_expr, ast.Call) and (
+                        _is_executor_call(item.context_expr)
+                    ):
+                        managed.add(id(item.context_expr))
+
+        def shutdowns_in(scope):
+            return {
+                name
+                for sub in ast.walk(scope)
+                if isinstance(sub, ast.Attribute) and sub.attr == "shutdown"
+                for name in (_target_name(sub.value),)
+                if name
+            }
+
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and _is_executor_call(node)):
+                continue
+            if id(node) in managed:
+                continue
+            name = bound.get(id(node))
+            if name:
+                scope = enclosing(
+                    node,
+                    ast.ClassDef
+                    if name.startswith("self.")
+                    else (ast.FunctionDef, ast.AsyncFunctionDef),
+                )
+                if name in shutdowns_in(scope):
+                    continue
+            factory = _func_name(node)
+            findings.append(Finding(
+                self.id, relpath, node.lineno,
+                f"{factory} is neither context-managed nor shut down in "
+                f"its owning scope — a leaked pool is pool_size un-joined "
+                f"threads wedging process shutdown",
+            ))
+
     # -- excepts -------------------------------------------------------------
 
     def _check_excepts(self, tree, relpath, findings) -> None:
@@ -203,7 +295,9 @@ class HygieneChecker(Checker):
 def _func_name(node: ast.Call) -> str:
     func = node.func
     if isinstance(func, ast.Attribute):
-        return f"threading.{func.attr}"
+        if func.attr in _THREAD_FACTORIES:
+            return f"threading.{func.attr}"
+        return func.attr
     if isinstance(func, ast.Name):
         return func.id
     return "Thread"

@@ -83,18 +83,23 @@ class ReadmeLintChecker(Checker):
 
 class AnalysisDocsChecker(Checker):
     """The analyzer's own README contract: the "Static analysis" section
-    documents every AST checker id and the suppression syntax."""
+    documents every invariant checker id (AST and lock-composition), the
+    suppression syntax, and the lock sanitizer's ``GOL_LOCKSAN`` knob."""
 
     id = "lint-analysis-docs"
     description = (
-        "README 'Static analysis' section names every AST checker id "
-        "and the '# gol: allow' suppression syntax"
+        "README 'Static analysis' section names every invariant checker "
+        "id, the '# gol: allow' suppression syntax, and the GOL_LOCKSAN "
+        "sanitizer knob"
     )
-    bug_class = "doc drift: an undocumented checker id or allow syntax"
+    bug_class = (
+        "doc drift: an undocumented checker id, allow syntax, or "
+        "sanitizer knob"
+    )
 
     def check_tree(self, root) -> Iterable[Finding]:
         from ..obs.lint import _readme_section
-        from . import ast_checkers
+        from . import ast_checkers, concurrency_checkers
 
         readme = rel_base(pathlib.Path(root)) / "README.md"
         try:
@@ -103,7 +108,7 @@ class AnalysisDocsChecker(Checker):
             return [Finding(self.id, "README.md", 1, f"cannot lint: {e}")]
         findings: List[Finding] = []
         line = _readme_line(readme, "## Static analysis")
-        for checker in ast_checkers():
+        for checker in ast_checkers() + concurrency_checkers():
             if checker.id not in section:
                 findings.append(Finding(
                     self.id, "README.md", line,
@@ -115,6 +120,13 @@ class AnalysisDocsChecker(Checker):
                 self.id, "README.md", line,
                 "suppression syntax ('# gol: allow(<check>): <why>') "
                 "missing from the 'Static analysis' section",
+            ))
+        if "GOL_LOCKSAN" not in section:
+            findings.append(Finding(
+                self.id, "README.md", line,
+                "the lock sanitizer's 'GOL_LOCKSAN' knob (utils/"
+                "locksan.py: env switch, watchdog deadline, artifact "
+                "path) is missing from the 'Static analysis' section",
             ))
         return findings
 

@@ -46,6 +46,7 @@ from ..obs import perf as _perf
 from ..obs import timeline as _timeline
 from ..obs import tracing as _tracing
 from ..ops import alive_cells
+from ..utils import locksan as _locksan
 from ..utils.cell import Cell
 
 
@@ -174,8 +175,8 @@ class Engine:
 
     def __init__(self, config: EngineConfig | None = None):
         self.config = config or EngineConfig()
-        self._lock = threading.Lock()
-        self._control = threading.Condition(self._lock)
+        self._lock = _locksan.lock("Engine._lock")
+        self._control = _locksan.condition("Engine._control", self._lock)
         # the device-resident board in its plane's representation (e.g. a
         # packed bitboard), owned by the run loop; kept after a run ends so
         # Retrieve keeps serving the final snapshot (the cWorld analogue)

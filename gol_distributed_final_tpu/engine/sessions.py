@@ -56,6 +56,7 @@ from ..obs import accounting as _acct
 from ..obs import instruments as _ins
 from ..obs import metrics as _metrics
 from ..obs import perf as _perf
+from ..utils import locksan as _locksan
 
 #: admission-refusal reasons — the stable label set of
 #: ``gol_sessions_rejected_total`` (README "Sessions" section)
@@ -153,7 +154,7 @@ class SessionTable:
 
             plane = auto_batch_plane(rule, self.shape)
         self._plane = plane
-        self._lock = threading.Lock()
+        self._lock = _locksan.lock("SessionTable._lock")
         self._state = None  # device batch [n, ...]; row i <-> _active[i]
         self._active: List[Session] = []
         self._pending: List[tuple[Session, np.ndarray]] = []
@@ -227,6 +228,10 @@ class SessionTable:
             with self._lock:
                 self._state = self._plane.append(self._state, new)
                 self._active.extend(s for s, _ in pending)
+                # gol: allow(atomicity): the grabbed prefix is stable by
+                # the concurrency contract — admit only APPENDS and
+                # advance is the single driver thread, so entries
+                # [0, len(pending)) are exactly the ones encoded above
                 del self._pending[: len(pending)]
         with self._lock:
             active = list(self._active)
@@ -327,6 +332,10 @@ class SessionTable:
                 self._state = (
                     self._plane.take(state, keep) if keep else None
                 )
+                # gol: allow(atomicity): only this single driver thread
+                # ever REPLACES _active; the earlier snapshot can only
+                # trail it by appends-via-_pending, which stay pending
+                # until the next advance — the compacted list is exact
                 self._active = [active[i] for i in keep]
                 left = len(self._active) + len(self._pending)
                 _ins.SESSIONS_ACTIVE.set(left)

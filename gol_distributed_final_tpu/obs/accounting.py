@@ -53,6 +53,7 @@ import threading
 from typing import Dict, Optional
 
 from . import metrics as _metrics
+from ..utils import locksan as _locksan
 
 SCHEMA = "gol-accounting/1"
 
@@ -135,7 +136,7 @@ class TenantLedger:
         if top_k < 1:
             raise ValueError(f"top_k must be >= 1, got {top_k}")
         self.top_k = top_k
-        self._lock = threading.Lock()
+        self._lock = _locksan.lock("TenantLedger._lock")
         self._tenants: Dict[str, _Usage] = {}
         self._other = _Usage()
         # DISTINCT tenants folded into other — itself bounded (8 x top_k

@@ -34,6 +34,7 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from . import instruments as _ins
+from ..utils import locksan as _locksan
 
 #: EWMA smoothing for per-address service times (one K-batch is one step)
 EWMA_ALPHA = 0.2
@@ -67,7 +68,7 @@ class CriticalPathTracker:
     }
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = _locksan.lock("CriticalPathTracker._lock")
         self._stats: Dict[str, _WorkerStat] = {}
         self._batches = 0
         self._last_gating: Optional[str] = None

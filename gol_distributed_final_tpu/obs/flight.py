@@ -32,6 +32,8 @@ import socket
 import threading
 import time
 from collections import deque
+
+from ..utils import locksan as _locksan
 from typing import List, Optional
 
 DEFAULT_CAPACITY = 512
@@ -47,7 +49,7 @@ class FlightRecorder:
 
     def __init__(self, capacity: int = DEFAULT_CAPACITY, enabled: bool = False):
         self.enabled = enabled
-        self._lock = threading.Lock()
+        self._lock = _locksan.lock("FlightRecorder._lock")
         self._ring: deque = deque(maxlen=capacity)
         self._seq = 0
 

@@ -458,3 +458,17 @@ WORKER_SKEW_RATIO = _R.gauge(
     "updated per K-batch — 1.0 is a balanced roster; the 'worker-skew' "
     "SLO GrowthRule alerts on its drift.",
 )
+
+# -- lock sanitizer (utils/locksan.py) ---------------------------------------
+
+LOCKSAN_VIOLATIONS_TOTAL = _R.counter(
+    "gol_locksan_violations_total",
+    "Lock-sanitizer incidents under GOL_LOCKSAN=1 (utils/locksan.py), "
+    "by kind: 'order' for an observed acquisition inverting the "
+    "recorded lock order (the acquiring thread also aborts with both "
+    "stacks), 'watchdog' for a lock held past GOL_LOCKSAN_DEADLINE "
+    "with waiters queued (all-thread tracebacks dumped to "
+    "out/locksan_<ts>.txt). Always 0 in production: the wrappers are "
+    "never installed without the env knob.",
+    labelnames=("kind",),
+)

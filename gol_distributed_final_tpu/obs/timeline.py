@@ -51,6 +51,7 @@ from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from . import metrics as _metrics
+from ..utils import locksan as _locksan
 
 logger = logging.getLogger(__name__)
 
@@ -218,12 +219,12 @@ class TimelineSampler:
         # deques mutate under it during sample ticks — an unlocked
         # iteration would race a concurrent append), and window() nests
         # summary() under the same lock
-        self._lock = threading.RLock()
+        self._lock = _locksan.rlock("TimelineSampler._lock")
         # serialises ticks + rule evaluation: concurrent maybe_sample
         # sites (engine chunk loop, Status polls, the background thread)
         # must produce ONE tick and ONE rulebook pass, or a single
         # worker-lost transition could double-increment the alert meter
-        self._tick_lock = threading.Lock()
+        self._tick_lock = _locksan.lock("TimelineSampler._tick_lock")
         self._series: Dict[Tuple[str, Tuple[str, ...]], _SeriesRing] = {}
         self._labelnames: Dict[str, Tuple[str, ...]] = {}
         self._seq = 0

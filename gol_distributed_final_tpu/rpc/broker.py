@@ -40,6 +40,7 @@ from ..obs import instruments as _ins
 from ..obs import metrics as _metrics
 from ..obs import perf as _perf
 from ..obs import tracing as _tracing
+from ..utils import locksan as _locksan
 from . import faults as _faults
 from . import integrity as _integrity
 from .client import RpcClient, RpcError
@@ -302,8 +303,12 @@ class WorkersBackend:
         self._probe_interval = probe_interval
         self._turn_seconds: float | None = None  # EWMA, turn-loop-local
         self._last_ckpt = 0.0
-        self._lock = threading.Lock()  # guards the roster maps (_GUARDED_BY)
-        self._control = threading.Condition(self._lock)
+        # guards the roster maps (_GUARDED_BY); GOL_LOCKSAN swaps in the
+        # instrumented wrapper (utils/locksan.py), plain Lock otherwise
+        self._lock = _locksan.lock("WorkersBackend._lock")
+        self._control = _locksan.condition(
+            "WorkersBackend._control", self._lock
+        )
         # the FULL roster is kept (not just the connected subset): a dead
         # or flapping address stays probe-able, so capacity recovers when
         # the worker comes back instead of only ever degrading
@@ -1566,8 +1571,8 @@ class SessionScheduler:
 
         self.capacity = capacity
         self.max_chunk = max_chunk
-        self._lock = threading.Lock()
-        self._work = threading.Condition(self._lock)
+        self._lock = _locksan.lock("SessionScheduler._lock")
+        self._work = _locksan.condition("SessionScheduler._work", self._lock)
         self._table = None  # current SessionTable (one geometry/rule)
         self._tags: dict[int, object] = {}  # session_id -> Session
         # session_id -> completed Session (bounded, insertion-ordered)
@@ -1667,8 +1672,17 @@ class SessionScheduler:
                         # turn, alive) instead of an error reply. HEALTHY
                         # completions only — a failed or cancelled
                         # session must stay a loud retrieve error, never
-                        # a healthy-looking partial snapshot
+                        # a healthy-looking partial snapshot.
+                        # gol: allow(atomicity): `sess` IS stale (admitted
+                        # under the earlier critical section), but the
+                        # check-then-act is re-validated HERE: the write
+                        # is gated on _tags still mapping tag -> sess
+                        # under this same lock, so a racing re-admission
+                        # of the tag can never be clobbered
                         self._finished[tag] = sess
+                        # gol: allow(atomicity): same re-validation — the
+                        # byte count moves with the entry the line above
+                        # just committed under this lock
                         self._finished_bytes += sess.result.nbytes
                         while self._finished and (
                             len(self._finished) > self._FINISHED_CAP
@@ -1770,7 +1784,7 @@ class BrokerService:
         # a broker that never serves sessions never starts the driver
         self._session_capacity = session_capacity
         self._sessions: SessionScheduler | None = None
-        self._sessions_lock = threading.Lock()
+        self._sessions_lock = _locksan.lock("BrokerService._sessions_lock")
 
     def _session_scheduler(self) -> SessionScheduler:
         with self._sessions_lock:

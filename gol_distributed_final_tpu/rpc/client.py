@@ -19,6 +19,7 @@ from ..obs import flight as _flight
 from ..obs import instruments as _ins
 from ..obs import metrics as _metrics
 from ..obs import tracing as _tracing
+from ..utils import locksan as _locksan
 from . import integrity as _integrity
 from .protocol import Methods, Request, recv_frame_sized, send_frame
 
@@ -81,17 +82,17 @@ class RpcClient:
         # guards transport swaps and the backoff state; NEVER held across
         # a dial, so close() and other threads' calls stay prompt while a
         # reconnect attempt waits out an unreachable peer's connect timeout
-        self._conn_lock = threading.Lock()
+        self._conn_lock = _locksan.lock("RpcClient._conn_lock")
         self._dialing = False
         self._user_closed = False
         self._ids = itertools.count()
         self._pending: dict[int, dict] = {}
-        self._pending_lock = threading.Lock()
+        self._pending_lock = _locksan.lock("RpcClient._pending_lock")
         # ONE write lock for the client's lifetime, not per-connection: a
         # sender that acquired it just before a reconnect swapped the
         # socket must still exclude senders on the new socket — two locks
         # would let their header+payload writes interleave on one stream
-        self._write_lock = threading.Lock()
+        self._write_lock = _locksan.lock("RpcClient._write_lock")
         self._install(self._dial())
 
     def _dial(self) -> socket.socket:
@@ -314,6 +315,12 @@ class RpcClient:
                             "request": request, "oob": 1}
                 if _integrity.enabled():
                     envelope["ck"] = 1
+                # gol: allow(blocking-under-lock): deliberate — ONE
+                # writer at a time per stream is the framing contract
+                # (header+payload must not interleave), so the send
+                # happens under the lifetime write lock by design; a
+                # sender stuck in sendall is woken by close()/reconnect
+                # via socket.shutdown (see _maybe_reconnect and close)
                 sent = send_frame(
                     sock,
                     envelope,

@@ -17,6 +17,7 @@ from ..obs import flight as _flight
 from ..obs import instruments as _ins
 from ..obs import metrics as _metrics
 from ..obs import tracing as _tracing
+from ..utils import locksan as _locksan
 from . import faults as _faults
 from . import integrity as _integrity
 from .protocol import (
@@ -47,7 +48,7 @@ class RpcServer:
         self._stopped = threading.Event()
         self._accept_thread: threading.Thread | None = None
         self._inflight = 0
-        self._inflight_cv = threading.Condition()
+        self._inflight_cv = _locksan.condition("RpcServer._inflight_cv")
 
     def register(self, name: str, fn) -> None:
         """Register a handler: fn(request_dataclass) -> response object."""
@@ -71,7 +72,7 @@ class RpcServer:
             ).start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
-        write_lock = threading.Lock()
+        write_lock = _locksan.lock("RpcServer.write_lock")
         # per-connection protocol-5 + checksum capability: each flips once
         # the peer's envelope advertises it, after which replies may use
         # out-of-band / checked frames; an old client never advertises and

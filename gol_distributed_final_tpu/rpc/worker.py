@@ -23,6 +23,7 @@ import time
 
 import numpy as np
 
+from ..utils import locksan as _locksan
 from . import faults as _faults
 from . import integrity as _integrity
 from .protocol import Methods, Request, Response
@@ -144,7 +145,7 @@ class WorkerService:
         # process, held across turns. (strip, turn, index) under a lock —
         # StripStart replaces it wholesale, so a reseed after loss recovery
         # can never leave a stale session behind.
-        self._strip_lock = threading.Lock()
+        self._strip_lock = _locksan.lock("WorkerService._strip_lock")
         self._strip: np.ndarray | None = None
         self._strip_turn = 0
         self._strip_index = 0
