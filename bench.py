@@ -401,6 +401,89 @@ def _bench_wire_modes(extra: dict) -> int:
     return 0
 
 
+def _bench_sparse_wire(extra: dict) -> int:
+    """Dirty-tile delta syncs (config 11): a <1%-active 16384² R-pentomino
+    on a loopback 4-worker RESIDENT cluster, measured at the run-end
+    StripFetch sync. The sparse side fetches deltas against the broker's
+    seed-time copy (ops/sparse.py wire tiles, reconstruction digest-
+    verified against the committed strip chain); the control side forces
+    full frames (``-sparse-sync off``). Byte accounting is deterministic,
+    so the ≥10× contract is a HARD gate (the PR 5 wire-byte posture), and
+    ``sparse_frame_bytes_per_sync`` rides into BENCH_r*.json so
+    ``obs/regress.py`` gates the trajectory alongside wire bytes."""
+    import numpy as np
+
+    from gol_distributed_final_tpu.obs import metrics as obs_metrics
+    from gol_distributed_final_tpu.rpc import worker as rpc_worker
+    from gol_distributed_final_tpu.rpc.broker import WorkersBackend
+    from gol_distributed_final_tpu.rpc.protocol import Methods, Request
+
+    def fetch_received() -> float:
+        total = 0.0
+        for fam in obs_metrics.registry().snapshot()["families"]:
+            if fam["name"] == "gol_wire_bytes_total":
+                for s in fam["series"]:
+                    if s.get("labels") == [Methods.STRIP_FETCH, "received"]:
+                        total += s["value"]
+        return total
+
+    size, turns = 16384, 1
+    board = np.zeros((size, size), np.uint8)
+    cx = cy = size // 2
+    for dx, dy in ((1, 0), (2, 0), (0, 1), (1, 1), (1, 2)):
+        board[cy + dy, cx + dx] = 255
+    sync_bytes = {}
+    for sparse in (True, False):
+        servers = [rpc_worker.serve(port=0) for _ in range(4)]
+        addrs = [f"127.0.0.1:{s.port}" for s, _ in servers]
+        backend = WorkersBackend(
+            addrs, wire="resident", halo_depth=1, sync_interval=0,
+            sparse_sync=sparse,
+        )
+        try:
+            b0 = fetch_received()
+            res = backend.run(Request(
+                world=board, turns=turns, threads=4,
+                image_width=size, image_height=size,
+            ))
+            sync_bytes[sparse] = fetch_received() - b0
+            if int(np.count_nonzero(res.world)) != int(
+                np.count_nonzero(oracle_step_n(
+                    board[cy - 8:cy + 8, cx - 8:cx + 8], turns
+                ))
+            ):
+                print("SPARSE WIRE PARITY FAILURE", file=sys.stderr)
+                return 1
+        finally:
+            backend.close()
+            for server, _service in servers:
+                server.stop()
+    delta_b, full_b = sync_bytes[True], sync_bytes[False]
+    if delta_b * 10 > full_b:
+        print(
+            f"SPARSE WIRE GATE FAILURE: delta sync ships {delta_b:.0f} B "
+            f"vs full gather {full_b:.0f} — less than the 10x contract",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"sparse wire gate ok: delta sync {delta_b:.0f} B vs full gather "
+        f"{full_b:.0f} B ({full_b / delta_b:.0f}x fewer)", file=sys.stderr,
+    )
+    extra["c11_sparse_wire_16384"] = {
+        # no wall-clock fit here — the contract is BYTES (deterministic);
+        # per_turn_us=0 keeps the case visible to bench_diff, which
+        # reports it incomparable on wall-clock and gates the bytes
+        "per_turn_us": 0.0,
+        "sparse_frame_bytes_per_sync": round(delta_b, 1),
+        "full_gather_bytes_per_sync": round(full_b, 1),
+        "bytes_ratio_vs_full": round(full_b / delta_b, 1),
+        "workers": 4,
+        "turns": turns,
+    }
+    return 0
+
+
 def _bench_sessions(extra: dict) -> int:
     """Multi-universe serving (config 8): 1k × 128² concurrent universes
     in ONE device-resident session batch (engine/sessions.SessionTable
@@ -899,14 +982,25 @@ def _bench_body() -> int:
     # negative throughput).
     from gol_distributed_final_tpu.bigboard import r_pentomino, seed_packed
 
+    from gol_distributed_final_tpu.ops.sparse import (
+        SparseBitPlane,
+        active_fraction_of,
+    )
+
     for size, key in ((16384, "c5_16384_sparse_bigboard"), (65536, "c5_65536_sparse_bigboard")):
         state_big = seed_packed(size, r_pentomino(size))
         plane_big = BitPlane(CONWAY, word_axis)
-        alive = bitpack.alive_count_packed(plane_big.step_n(state_big, 1000))
+        state_1000 = plane_big.step_n(state_big, 1000)
+        alive = bitpack.alive_count_packed(state_1000)
         if alive != 156:  # oracle-validated (tests/test_bigboard.py methodology)
             print(f"PARITY FAILURE {size}^2: {alive} != 156", file=sys.stderr)
             return 1
         print(f"parity {size}^2 ok (R-pentomino, 1000 turns)", file=sys.stderr)
+        # the sparsity the dense path ignores: active-tile fraction of
+        # the evolved board (ops/sparse.py tile geometry) — near zero
+        # here, which is exactly why the c10 sparse pair below wins
+        af_big = active_fraction_of(state_1000)
+        del state_1000
 
         def evolve_big(n, state_big=state_big, plane_big=plane_big):
             return bitpack.alive_count_packed(plane_big.step_n(state_big, n))
@@ -914,13 +1008,93 @@ def _bench_body() -> int:
         n5_lo, n5_hi = (2_000, 22_000) if size == 16384 else (500, 3_500)
         evolve_big(n5_lo), evolve_big(n5_hi)
         pt_big, det_big = gated(evolve_big, n5_lo, n5_hi, key)
-        extra[key] = dict(det_big, cell_updates_per_s=round(size * size / pt_big))
+        extra[key] = dict(
+            det_big,
+            cell_updates_per_s=round(size * size / pt_big),
+            # per-ACTIVE-cell accounting (ISSUE 14 satellite): the dense
+            # path updates the whole board to serve this tiny active
+            # fraction, so its active throughput is cell_updates x af —
+            # the figure obs/regress.py now gates alongside wall-clock
+            active_fraction=round(af_big, 6),
+            cell_updates_per_s_active=round(size * size * af_big / pt_big),
+        )
+
+        # ---- config 10: the sparse-vs-dense pair (16384^2 R-pentomino) ---
+        # The activity-sparse plane (ops/sparse.SparseBitPlane) against
+        # the dense fit just measured, SAME seed: the acceptance gate is
+        # >= 5x wall-clock over 1000 turns with bit-identical boards.
+        if size == 16384:
+            sp = SparseBitPlane(CONWAY)
+            sp_seed = sp.from_packed(state_big)
+            want_pk = plane_big.step_n(state_big, 1000)
+            got = sp.step_n(sp_seed, 1000)
+            if not bool(jnp.all(got.packed == want_pk)):
+                print(
+                    "SPARSE PARITY FAILURE: 16384^2 R-pentomino sparse "
+                    "!= dense at 1000 turns", file=sys.stderr,
+                )
+                return 1
+            print(
+                "parity 16384^2 sparse ok (1000 turns, bit-identical to "
+                "dense)", file=sys.stderr,
+            )
+            del want_pk
+
+            def evolve_sp(n, sp=sp, sp_seed=sp_seed):
+                return bitpack.alive_count_packed(
+                    sp.step_n(sp_seed, n).packed
+                )
+
+            n10_lo, n10_hi = 500, 2_500
+            evolve_sp(n10_lo), evolve_sp(n10_hi)
+            pt_sp, det_sp = gated(
+                evolve_sp, n10_lo, n10_hi, "c10_16384_rpent_sparse"
+            )
+            wall_sparse = None
+            for _ in range(3):
+                t0 = time.perf_counter()
+                evolve_sp(1000)
+                dt = time.perf_counter() - t0
+                wall_sparse = dt if wall_sparse is None else min(wall_sparse, dt)
+            wall_dense = pt_big * 1000
+            speedup = wall_dense / wall_sparse
+            af_sp = sp.active_fraction(got)
+            if speedup < 5.0:
+                print(
+                    f"SPARSE GATE FAILURE: 16384^2 R-pentomino sparse is "
+                    f"only {speedup:.1f}x dense over 1000 turns "
+                    f"({wall_sparse:.3f}s vs {wall_dense:.3f}s) — less "
+                    "than the 5x contract", file=sys.stderr,
+                )
+                return 1
+            print(
+                f"sparse gate ok: 1000 turns in {wall_sparse:.3f}s vs "
+                f"dense {wall_dense:.3f}s ({speedup:.1f}x)",
+                file=sys.stderr,
+            )
+            extra["c10_16384_rpent_sparse"] = dict(
+                det_sp,
+                cell_updates_per_s=round(size * size / pt_sp),
+                active_fraction=round(af_sp, 6),
+                cell_updates_per_s_active=round(
+                    size * size * af_sp / pt_sp
+                ),
+                wall_1000_turns_s=round(wall_sparse, 4),
+                dense_wall_1000_turns_s=round(wall_dense, 4),
+                speedup_vs_dense=round(speedup, 1),
+            )
+            del evolve_sp, sp_seed, got, sp
         # drop BOTH references (the closure's default-arg binding keeps the
         # device buffer alive otherwise) so the 512 MiB frees between sizes
         del evolve_big, state_big
 
     # ---- config 7: the RPC data plane — wire modes, loopback 4 workers ----
     rc = _bench_wire_modes(extra)
+    if rc:
+        return rc
+
+    # ---- config 11: dirty-tile delta syncs — sparse resident wire --------
+    rc = _bench_sparse_wire(extra)
     if rc:
         return rc
 

@@ -235,7 +235,15 @@ def load_resume_checkpoint(path, keep: int = 1) -> tuple[np.ndarray, int, LifeRu
     → ``(board, turn, rule, generation)``. Tries gen 0 … keep-1 in order
     and falls back past unverifiable files; raises a CheckpointError
     listing every attempt when none verifies — resuming from nothing must
-    be an operator decision, never a silent from-zero run."""
+    be an operator decision, never a silent from-zero run.
+
+    When verified DELTA checkpoints newer than the chosen full generation
+    exist beside it (``save_delta_checkpoint`` — the broker's
+    auto-checkpoint writes them between full keyframes), the newest one
+    that applies AND verifies advances the resume point; a corrupted or
+    mismatched-base delta is skipped loudly (every delta is depth-1 from
+    its full keyframe, so skipping one only costs its turns, never the
+    chain)."""
     attempts = []
     for gen in range(max(1, keep)):
         p = generation_path(path, gen)
@@ -251,12 +259,201 @@ def load_resume_checkpoint(path, keep: int = 1) -> tuple[np.ndarray, int, LifeRu
         except CheckpointError as exc:
             attempts.append(f"{p}: [{exc.kind}] {exc}")
             continue
+        for dturn, dpath in reversed(delta_checkpoint_paths(path)):
+            if dturn <= turn:
+                break
+            try:
+                board_d, turn_d = apply_delta_checkpoint(
+                    dpath, board, turn, rule
+                )
+            except CheckpointError as exc:
+                attempts.append(f"{dpath}: [{exc.kind}] {exc}")
+                continue
+            return board_d, turn_d, rule, gen
         return board, turn, rule, gen
     raise CheckpointError(
         "no verifiable checkpoint generation to resume from:\n  "
         + "\n  ".join(attempts),
         kind="exhausted",
     )
+
+
+# -- delta checkpoints (dirty-tile deltas between full generations) ----------
+#
+# The broker's auto-checkpoint accumulates a per-tile dirty bitmap from the
+# resident workers' StripStep replies (ops/sparse.py wire tiles) and, between
+# full keyframes, writes only the tiles that changed since the last FULL
+# checkpoint — so every delta applies directly onto its keyframe (depth-1,
+# never a delta-on-delta chain) and a <1%-active big board checkpoints in a
+# fraction of the full write. Integrity mirrors the full format: the file
+# embeds the digest of the base it applies to AND the digest of the board it
+# must produce, so a wrong base, a flipped tile byte, or a truncated payload
+# is a LOUD typed refusal at load time, never a silently-wrong resume.
+
+
+def delta_checkpoint_path(path, turn: int) -> pathlib.Path:
+    """Where the delta at ``turn`` lives: ``<stem>.d<turn>.npz`` beside
+    the configured full checkpoint path."""
+    p = npz_path(path)
+    return p.with_name(f"{p.stem}.d{int(turn)}.npz")
+
+
+def delta_checkpoint_paths(path) -> list[tuple[int, pathlib.Path]]:
+    """Existing delta files for a checkpoint path, ``(turn, path)``
+    sorted by turn ascending."""
+    import re
+
+    p = npz_path(path)
+    pat = re.compile(re.escape(p.stem) + r"\.d(\d+)\.npz$")
+    out = []
+    for cand in p.parent.glob(f"{p.stem}.d*.npz"):
+        m = pat.match(cand.name)
+        if m:
+            out.append((int(m.group(1)), cand))
+    return sorted(out)
+
+
+def clear_delta_checkpoints(path) -> None:
+    """Drop every delta beside ``path`` — called when a new full keyframe
+    lands (the deltas applied to the OLD base; their base digest would
+    refuse anyway, this just keeps the directory honest). Best-effort."""
+    for _turn, p in delta_checkpoint_paths(path):
+        try:
+            p.unlink()
+        except OSError:
+            pass  # a stale delta is refused by digest, never resumed
+
+
+def save_delta_checkpoint(
+    path,
+    board,
+    dirty: np.ndarray,
+    turn: int,
+    rule: LifeRule,
+    base_turn: int,
+    base_digest: str,
+) -> pathlib.Path:
+    """Write the dirty tiles of ``board`` as a delta against the full
+    checkpoint whose board hashed to ``base_digest`` at ``base_turn``.
+    Written tmp-then-rename like every checkpoint: a crash mid-write
+    leaves no half-delta behind."""
+    from ..ops.sparse import extract_dirty_tiles, wire_tile_grid
+
+    board = np.asarray(board, np.uint8)
+    dirty = np.asarray(dirty, bool)
+    if dirty.shape != wire_tile_grid(board.shape):
+        raise ValueError(
+            f"dirty grid {dirty.shape} does not match board "
+            f"{board.shape}'s wire-tile grid"
+        )
+    final = delta_checkpoint_path(path, turn)
+    tmp = final.with_name(final.name + ".tmp")
+    written = _save_npz(
+        tmp,
+        dirty=dirty,
+        tiles=extract_dirty_tiles(board, dirty),
+        height=np.int64(board.shape[0]),
+        width=np.int64(board.shape[1]),
+        turn=np.int64(turn),
+        base_turn=np.int64(base_turn),
+        base_digest=np.str_(base_digest),
+        rulestring=np.str_(rule.rulestring),
+        format_version=np.int64(CKPT_FORMAT_VERSION),
+        digest=np.str_(checkpoint_digest(board, turn, rule.rulestring)),
+    )
+    written.replace(final)
+    return final
+
+
+def apply_delta_checkpoint(
+    path, base_board: np.ndarray, base_turn: int, rule: LifeRule
+) -> tuple[np.ndarray, int]:
+    """Apply one delta file onto its verified base -> ``(board, turn)``.
+    Refuses loudly (typed CheckpointError, counted on
+    ``gol_ckpt_verify_total``) when the base is not the one the delta was
+    cut against, when the payload is malformed, or when the applied
+    result does not hash to the embedded digest — the corrupted-delta
+    contract tests/test_sparse.py pins."""
+    from ..ops.sparse import apply_dirty_tiles
+
+    path = pathlib.Path(path)
+    try:
+        try:
+            with np.load(path, allow_pickle=False) as data:
+                missing = [
+                    k
+                    for k in (
+                        "dirty", "tiles", "height", "width", "turn",
+                        "base_turn", "base_digest", "rulestring", "digest",
+                    )
+                    if k not in data
+                ]
+                if missing:
+                    raise CheckpointError(
+                        f"{path} is missing delta field(s) "
+                        f"{', '.join(missing)}: not a delta checkpoint, or "
+                        "one cut short mid-write",
+                        kind="truncated",
+                    )
+                dirty = np.asarray(data["dirty"], bool)
+                tiles = np.asarray(data["tiles"], np.uint8)
+                shape = (int(data["height"]), int(data["width"]))
+                turn = int(data["turn"])
+                d_base_turn = int(data["base_turn"])
+                base_digest = str(data["base_digest"])
+                rulestring = str(data["rulestring"])
+                stored = str(data["digest"])
+                version = (
+                    int(data["format_version"])
+                    if "format_version" in data else CKPT_FORMAT_VERSION
+                )
+        except CheckpointError:
+            raise
+        except Exception as exc:
+            raise CheckpointError(
+                f"{path} is not a readable delta checkpoint "
+                f"({type(exc).__name__}: {exc})",
+                kind="unreadable",
+            ) from exc
+        if rulestring != rule.rulestring:
+            raise CheckpointError(
+                f"{path} is a {rulestring} delta applied to a "
+                f"{rule.rulestring} base", kind="format",
+            )
+        base_board = np.asarray(base_board, np.uint8)
+        if shape != base_board.shape or d_base_turn != base_turn:
+            raise CheckpointError(
+                f"{path} was cut against a {shape} board at turn "
+                f"{d_base_turn}, not this {base_board.shape} base at "
+                f"turn {base_turn}", kind="delta-base",
+            )
+        if (
+            checkpoint_digest(base_board, base_turn, rulestring, version)
+            != base_digest
+        ):
+            raise CheckpointError(
+                f"{path}: the base board does not hash to the delta's "
+                "embedded base digest — it applies to a different full "
+                "generation", kind="delta-base",
+            )
+        try:
+            board = apply_dirty_tiles(base_board, dirty, tiles)
+        except ValueError as exc:
+            raise CheckpointError(
+                f"{path}: malformed delta payload ({exc})", kind="truncated",
+            ) from exc
+        if checkpoint_digest(board, turn, rulestring, version) != stored:
+            raise CheckpointError(
+                f"{path} failed digest verification: the applied board "
+                "does not hash to the embedded digest — the delta is "
+                "corrupt; resume falls back to the full generation",
+                kind="digest",
+            )
+    except CheckpointError:
+        _ins.CKPT_VERIFY_TOTAL.labels("fail").inc()
+        raise
+    _ins.CKPT_VERIFY_TOTAL.labels("ok").inc()
+    return board, turn
 
 
 def save_packed_checkpoint(

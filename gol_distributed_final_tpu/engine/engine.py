@@ -372,6 +372,18 @@ class Engine:
                         n = 1
                     state = self._state
                     active_plane = self._plane
+                    # early exit (ops/plane.py protocol): a plane that
+                    # marked its state steady — still life or period-2 —
+                    # jumps ALL remaining turns arithmetically in this
+                    # one "chunk"; the commit below then ends the run
+                    # with the exact final board and turn count
+                    early = None
+                    if not emit_flips:
+                        from ..ops.plane import plane_steady_kind
+
+                        early = plane_steady_kind(active_plane, state)
+                        if early:
+                            n = params.turns - self._turn
 
                 growing = not emit_flips and not growth_done
                 t0 = time.monotonic()
@@ -385,10 +397,18 @@ class Engine:
                     if _tracing.enabled() else None
                 )
                 with _tracing.annotate("engine.chunk"):
-                    # gol: allow(jit-cache): chunk doubles by powers of
-                    # two; the min() only clips the FINAL remainder, so a
-                    # run compiles at most log2(turns)+2 distinct n values
-                    new_state = active_plane.step_n(state, n)
+                    if early:
+                        # O(1): a still life is itself, a period-2 cycle
+                        # lands on phase n % 2 — no dispatch at all
+                        # (gol_early_exit_total was metered by the plane
+                        # at DETECTION; this jump just cashes it in)
+                        new_state = active_plane.fast_forward(state, n)
+                    else:
+                        # gol: allow(jit-cache): chunk doubles by powers
+                        # of two; the min() only clips the FINAL
+                        # remainder, so a run compiles at most
+                        # log2(turns)+2 distinct n values
+                        new_state = active_plane.step_n(state, n)
                 if growing:
                     # accurate per-chunk timing drives the doubling below
                     new_state.block_until_ready()
@@ -412,17 +432,24 @@ class Engine:
                     _ins.TURN_SEGMENT_SECONDS.labels(
                         "engine", "device_compute"
                     ).observe(elapsed)
-                if _metrics.enabled():
+                if _metrics.enabled() and not early:
                     # per-turn attribution (obs/): dispatch wall spread over
                     # the chunk's turns, so the step histogram's COUNT is
                     # the turn count (growth chunks are synchronous and
                     # accurate; pipelined chunks record enqueue time — the
-                    # device-side truth lives in the jax.profiler trace)
+                    # device-side truth lives in the jax.profiler trace).
+                    # An early-exit jump is EXCLUDED: its millions of
+                    # credited turns were never computed, and booking them
+                    # as ~0-latency samples would crater the step p99 and
+                    # fake the throughput panels (the sessions dead-retire
+                    # posture: gol_early_exit_total is the meter for
+                    # skipped turns, these meters count COMPUTED ones)
                     _ins.ENGINE_DISPATCH_SECONDS.observe(elapsed)
                     _ins.ENGINE_STEP_SECONDS.observe_n(elapsed / n, n)
                     _ins.ENGINE_TURNS_TOTAL.inc(n)
                     _ins.ENGINE_CHUNKS_TOTAL.inc()
                     _ins.ENGINE_CHUNK_SIZE.set(chunk)
+                if _metrics.enabled():
                     # per-chunk HBM occupancy (obs/device.py): the gauges
                     # that bound a TPU run, live on the Status verb and
                     # the watch dashboard; one cached early-return on

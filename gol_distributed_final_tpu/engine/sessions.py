@@ -140,6 +140,7 @@ class SessionTable:
         *,
         plane=None,
         max_chunk: int = 4096,
+        retire_dead: bool = True,
     ):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
@@ -149,6 +150,14 @@ class SessionTable:
         self.shape = tuple(shape)
         self.capacity = capacity
         self.max_chunk = max_chunk
+        # early-retire all-dead universes: under a non-B0 rule a universe
+        # whose batched alive count hit 0 can never change again, so its
+        # remaining budget is credited arithmetically at the next advance
+        # boundary instead of burning batched dispatches to the end
+        # (gol_early_exit_total{kind="dead"}); FinalTurnComplete carries
+        # the full budget turn and the (empty) final board, exactly like
+        # a computed drain. B0 rules disable it — a dead board births.
+        self.retire_dead = retire_dead and not (rule.birth_mask & 1)
         if plane is None:
             from ..ops.auto import auto_batch_plane
 
@@ -291,6 +300,17 @@ class SessionTable:
                             (s, AliveCellsCount(s.turns_done, s.alive_count))
                         )
                         events.append((s, TurnComplete(s.turns_done)))
+                    if (
+                        self.retire_dead
+                        and s.alive_count == 0
+                        and s.remaining > 0
+                    ):
+                        # all-dead universe: it can never change again
+                        # (non-B0 rule), so credit the remaining budget
+                        # arithmetically — the per-chunk batched count
+                        # already proved there is nothing left to compute
+                        s.turns_done = s.turns
+                        _ins.EARLY_EXIT_TOTAL.labels("dead").inc()
                 if s.cancelled or s.remaining == 0:
                     finished.append(i)
             if advanced:

@@ -325,9 +325,10 @@ AUTO_CHECKPOINT_TOTAL = _R.counter(
 OPS_PLANE_SELECTED_TOTAL = _R.counter(
     "gol_ops_plane_selected_total",
     "Automatic data-plane routing decisions, by selected tier "
-    "(bitplane / roll_stencil / pallas_bit_step / packed_xla_step, plus "
-    "the batched family's batch_bitplane / batch_roll_stencil). Cached "
-    "per (rule, shape): counts DECISIONS, not admissions.",
+    "(bitplane / sparse_bitplane / roll_stencil / pallas_bit_step / "
+    "packed_xla_step, plus the batched family's batch_bitplane / "
+    "batch_roll_stencil). Cached per (rule, shape): counts DECISIONS, "
+    "not admissions.",
     labelnames=("plane",),
 )
 COMPILE_CACHE_REQUESTS_TOTAL = _R.counter(
@@ -457,6 +458,40 @@ WORKER_SKEW_RATIO = _R.gauge(
     "service-time EWMA over the roster median (obs/critical.py), "
     "updated per K-batch — 1.0 is a balanced roster; the 'worker-skew' "
     "SLO GrowthRule alerts on its drift.",
+)
+
+# -- activity-sparse stepping (ops/sparse.py, rpc/ dirty-tile deltas,
+#    engine early exits) ------------------------------------------------------
+
+ACTIVE_TILES = _R.gauge(
+    "gol_active_tiles",
+    "Active tiles after the most recent sparse step chunk (ops/sparse."
+    "SparseBitPlane) — or, on a resident-wire broker, dirty tiles "
+    "reported by the roster's latest StripStep batch. The frontier size "
+    "the SPARSITY watch panel tracks.",
+)
+TILE_SKIPS_TOTAL = _R.counter(
+    "gol_tile_skips_total",
+    "Tiles NOT computed by the sparse stepper (total tiles minus active, "
+    "summed per turn): the work the activity bitmap saved vs the dense "
+    "path.",
+)
+SPARSE_FRAME_BYTES_TOTAL = _R.counter(
+    "gol_sparse_frame_bytes_total",
+    "Payload bytes of dirty-tile delta frames shipped instead of full "
+    "gathers (resident-wire StripFetch deltas: flat tile buffer + dirty "
+    "bitmap) — the sparse-wire meter bench embeds as "
+    "sparse_frame_bytes_per_sync and bench_diff gates.",
+)
+EARLY_EXIT_TOTAL = _R.counter(
+    "gol_early_exit_total",
+    "Runs short-circuited arithmetically instead of computed, by kind: "
+    "'still' (activity bitmap drained — a still life's remaining turns "
+    "are no-ops), 'period2' (board(t+2) == board(t): blinker-stable, "
+    "remaining turns resolve by parity), 'dead' (a batched session "
+    "universe's alive count hit 0 under a non-B0 rule: retired at the "
+    "next boundary with its full budget credited).",
+    labelnames=("kind",),
 )
 
 # -- lock sanitizer (utils/locksan.py) ---------------------------------------

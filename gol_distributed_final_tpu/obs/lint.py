@@ -88,6 +88,11 @@ _REQUIRED_SECTIONS = (
     # obs/critical.py): metric table, bound-class semantics, CLI
     # examples, and the honest calibration caveats
     "## Performance attribution",
+    # the activity-sparse stepping contract (ops/sparse.py + the
+    # dirty-tile wire/checkpoint deltas): the activity invariant, the
+    # density crossover, the delta-frame format, the early-exit
+    # contract, and the knobs
+    "## Sparse stepping",
 )
 
 # the wire data-plane metric families (rpc/protocol.py frames + the
@@ -278,6 +283,30 @@ def undocumented_perf_names(readme_path=None) -> List[str]:
     return sorted(n for n in _PERF_METRIC_NAMES if n not in section)
 
 
+# the activity-sparse metric families (ops/sparse.py, the rpc/ dirty-tile
+# deltas, the engine/sessions early exits) plus the contract vocabulary:
+# these must be documented in the README's "Sparse stepping" section
+# specifically — the operator contract for the frontier/skip/delta/exit
+# surface the SPARSITY watch panel renders and bench_diff gates
+_SPARSE_DOC_NAMES = (
+    "gol_active_tiles",
+    "gol_tile_skips_total",
+    "gol_sparse_frame_bytes_total",
+    "gol_early_exit_total",
+    "GOL_SPARSE",
+    "-sparse-sync",
+)
+
+
+def undocumented_sparse_names(readme_path=None) -> List[str]:
+    """Sparse-stepping metric/knob names missing from the README's
+    "Sparse stepping" section specifically (the wire/device-table
+    posture: a name mentioned elsewhere in the file does not count as
+    documented here)."""
+    section = _readme_section(readme_path, "## Sparse stepping")
+    return sorted(n for n in _SPARSE_DOC_NAMES if n not in section)
+
+
 def missing_readme_sections(readme_path=None) -> List[str]:
     """Required operator-facing README sections that are absent."""
     if readme_path is None:
@@ -377,6 +406,14 @@ CHECKS = (
         "README.md's Performance attribution section:",
         "perf lint ok: every attribution metric and bound class is in "
         "the Performance attribution section",
+    ),
+    (
+        "lint-sparse-metrics",
+        undocumented_sparse_names,
+        "sparse-stepping metric/knob names missing from README.md's "
+        "Sparse stepping section:",
+        "sparse lint ok: every sparse metric and knob is in the Sparse "
+        "stepping section",
     ),
     (
         "lint-sections",

@@ -191,6 +191,7 @@ def compare_case(
     if not old_us or not new_us:
         out["verdict"] = "incomparable"
         out = _apply_roofline_gate(old, new, out, threshold, 0.0)
+        out = _apply_sparse_gates(old, new, out, threshold, 0.0)
         return _apply_wire_bytes_gate(old, new, out, threshold)
     delta = new_us - old_us
     rel = delta / old_us
@@ -212,6 +213,7 @@ def compare_case(
     else:
         out["verdict"] = "improved" if -rel > threshold else "faster"
     out = _apply_roofline_gate(old, new, out, threshold, noise_us / old_us)
+    out = _apply_sparse_gates(old, new, out, threshold, noise_us / old_us)
     return _apply_wire_bytes_gate(old, new, out, threshold)
 
 
@@ -246,6 +248,45 @@ def _apply_roofline_gate(
     old_c, new_c = old.get("bound_class"), new.get("bound_class")
     if old_c and new_c and old_c != new_c:
         out["bound_class_change"] = f"{old_c} -> {new_c}"
+    return out
+
+
+def _apply_sparse_gates(
+    old: dict, new: dict, out: dict, threshold: float, noise_rel: float
+) -> dict:
+    """The activity-sparse gates (ISSUE 14 satellite): per-ACTIVE-cell
+    throughput (``cell_updates_per_s_active`` on the sparse-board cases)
+    gates like achieved FLOP/s — a drop past threshold AND the noise
+    band is REGRESSED in its own units — and delta-sync byte growth
+    (``sparse_frame_bytes_per_sync``) gates like wire bytes: byte
+    accounting is deterministic, so no noise band applies."""
+    old_a, new_a = (
+        old.get("cell_updates_per_s_active"),
+        new.get("cell_updates_per_s_active"),
+    )
+    if old_a and new_a:
+        drop_rel = (old_a - new_a) / old_a
+        out["old_active_updates_per_s"] = old_a
+        out["new_active_updates_per_s"] = new_a
+        out["active_delta_pct"] = -100.0 * drop_rel
+        if drop_rel > threshold + noise_rel:
+            out["verdict"] = "REGRESSED"
+            out["why"] = (
+                "per-active-cell throughput fell past threshold beyond "
+                "the noise band"
+            )
+    old_b, new_b = (
+        old.get("sparse_frame_bytes_per_sync"),
+        new.get("sparse_frame_bytes_per_sync"),
+    )
+    if old_b and new_b:
+        bytes_rel = (new_b - old_b) / old_b
+        out["old_sparse_sync_bytes"] = old_b
+        out["new_sparse_sync_bytes"] = new_b
+        out["sparse_sync_delta_pct"] = 100.0 * bytes_rel
+        if bytes_rel > threshold:
+            out["verdict"] = "REGRESSED"
+            out["why"] = "sparse sync bytes grew past threshold"
     return out
 
 

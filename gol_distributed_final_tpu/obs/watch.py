@@ -250,6 +250,36 @@ def _session_lines(snap: dict) -> List[str]:
     return out
 
 
+def _sparsity_lines(snap: dict) -> List[str]:
+    """The activity-sparse column (ops/sparse.py + the dirty-tile wire
+    deltas): current frontier size (``gol_active_tiles`` — the sparse
+    stepper's bitmap, or a resident broker's latest batch dirty total),
+    the tiles the activity bitmap saved, delta-frame bytes shipped
+    instead of full gathers, and the runs short-circuited arithmetically
+    by kind. A fully dense deployment renders nothing."""
+    active = _scalar(snap, "gol_active_tiles")
+    skips = _scalar(snap, "gol_tile_skips_total")
+    delta_bytes = _scalar(snap, "gol_sparse_frame_bytes_total")
+    exits = _series_map(snap, "gol_early_exit_total")
+    total_exits = sum(s.get("value") or 0 for s in exits.values())
+    if not active and not skips and not delta_bytes and not total_exits:
+        return []
+    out = ["SPARSITY (activity-sparse)"]
+    out.append(
+        f"  active tiles {int(active or 0):,}   tile skips "
+        f"{int(skips or 0):,}   delta frames "
+        f"{_human_bytes(delta_bytes or 0)}"
+    )
+    if total_exits:
+        kinds = ", ".join(
+            f"{(labels[0] if labels else '?')} {int(s.get('value') or 0)}"
+            for labels, s in sorted(exits.items())
+            if s.get("value")
+        )
+        out.append(f"  early exits {int(total_exits)}  ({kinds})")
+    return out
+
+
 def _tenant_lines(payload: dict, top: int = 8) -> List[str]:
     """The usage-accounting column (obs/accounting.py TenantLedger,
     shipped as the Status ``accounting`` payload): who is spending this
@@ -531,6 +561,7 @@ def render_status(
         _rpc_lines(snap),
         _wire_lines(snap),
         _session_lines(snap),
+        _sparsity_lines(snap),
         _tenant_lines(payload),
         _integrity_lines(snap),
         _worker_lines(payload),

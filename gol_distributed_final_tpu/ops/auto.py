@@ -71,10 +71,21 @@ def auto_plane(rule, shape: tuple[int, int]):
         _note_selection("roll_stencil")
         plane = None
     else:
-        from .plane import BitPlane
+        from .sparse import SparseBitPlane, sparse_capable
 
-        _note_selection("bitplane")
-        plane = BitPlane(rule, word_axis)
+        if word_axis == 0 and sparse_capable(rule, shape):
+            # big boards go quiescent almost everywhere: the activity-
+            # sparse plane steps only the live frontier and falls back
+            # to the dense bitboard path by itself above the density
+            # crossover (ops/sparse.py — the GOL_SPARSE knob and the
+            # SPARSE_MIN_CELLS floor live there)
+            _note_selection("sparse_bitplane")
+            plane = SparseBitPlane(rule)
+        else:
+            from .plane import BitPlane
+
+            _note_selection("bitplane")
+            plane = BitPlane(rule, word_axis)
     _PLANE_CACHE[key] = plane
     return plane
 

@@ -104,6 +104,24 @@ def _aligned_divisors(n: int, align: int):
     return [d for d in range(align, n + 1, align) if n % d == 0]
 
 
+def sparse_tile_shape(packed_shape: tuple[int, int]) -> tuple[int, int]:
+    """Default activity-tile geometry for the sparse layer (ops/sparse.
+    SparseBitPlane): (word rows, cols) per tile, aligned with this
+    kernel's Mosaic tiling (8-sublane x 128-lane) when the packed shape
+    allows — so a sparse frontier's gather windows coincide with the
+    tiles the dense kernel would process — and falling back to smaller
+    exact divisors so small boards still get a multi-tile grid (at
+    least ~8 tiles per axis when any divisor allows it)."""
+
+    def pick(n: int, cap: int, min_grid: int = 8) -> int:
+        divisors = [d for d in range(1, cap + 1) if n % d == 0]
+        fine = [d for d in divisors if n // d >= min_grid]
+        return max(fine) if fine else max(divisors)
+
+    rows, width = packed_shape
+    return pick(rows, _SUBLANE), pick(width, _LANE)
+
+
 def _validate_block(name: str, val: int, total: int, align: int) -> None:
     if val % align or total % val:
         raise ValueError(

@@ -14,6 +14,17 @@ Interface (duck-typed):
     step_n(state, n) -> state         n turns, one or few dispatches
     decode(state) -> np.uint8 [H, W]  full host board (Retrieve/final only)
     alive_count(state) -> int         device-side reduction, tiny transfer
+
+Optional early-exit protocol (ops/sparse.SparseBitPlane implements it;
+the engine consumes it through :func:`plane_steady_kind`):
+    steady_kind(state) -> None | "still" | "period2"
+                                      the plane's own verdict that the
+                                      board has gone quiescent (set by a
+                                      previous step_n, never computed on
+                                      demand)
+    fast_forward(state, k) -> state   k turns of a steady state in O(1)
+                                      (a still life is itself; a
+                                      period-2 cycle lands on phase k%2)
 """
 
 from __future__ import annotations
@@ -32,6 +43,18 @@ from ..obs import device as _device
 # failure for a shape routes it to the tiled/XLA path instead of crashing,
 # and the decision is cached so the compile is never re-attempted.
 _VMEM_KERNEL_OK: dict = {}
+
+
+def plane_steady_kind(plane, state):
+    """The early-exit protocol's read side, shared by every consumer
+    (engine/engine.py's chunk loop): ``None`` unless the plane both
+    implements ``steady_kind`` and has marked this state steady — so a
+    caller can always gate a fast_forward jump on one call without
+    caring which plane it holds."""
+    probe = getattr(plane, "steady_kind", None)
+    if probe is None or state is None:
+        return None
+    return probe(state)
 
 
 def run_vmem_gated(cache: dict, key, kernel_call, fallback_call):

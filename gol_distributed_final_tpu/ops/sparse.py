@@ -1,0 +1,693 @@
+"""Activity-sparse stepping — compute only where the board is alive.
+
+Every dense tier pays O(board) per turn regardless of content: BENCH_r05
+times the 16384^2 and 65536^2 R-pentomino cases at full dense cost
+(~131 us and ~3.6 ms/turn) even though <1% of the board is ever active.
+This module turns per-turn cost into O(active frontier) — the classic
+Life optimisation recast in the convolution+rule shape the existing
+bitboard kernel already has (CAX, arxiv 2410.02651), with the tile-block
+decomposition following the TPU playbook of arxiv 2112.09017.
+
+The invariant (exact for any life-like rule WITHOUT birth-on-0, i.e.
+``not rule.birth_mask & 1``): a cell can change at turn t -> t+1 only if
+some cell in its 3x3 neighbourhood changed at t-1 -> t. Lifted to tiles
+(>= 1 cell on every side), a tile can change next turn only if it or one
+of its 8 neighbours changed this turn — so the active set evolves as
+``active(t+1) = dilate3x3(changed(t))`` and everything outside it is
+skipped without ever being read.
+
+``SparseBitPlane`` is a drop-in data plane (ops/plane.py interface) over
+the int32 bitboard: the board and the [GR, GC] activity bitmap both live
+on device, and ``step_n`` runs the whole turn loop in ONE dispatch — a
+``lax.while_loop`` whose body gathers the active tiles (indices from
+``jnp.nonzero(..., size=capacity)``) into a compact halo-extended batch,
+advances it ``SPARSE_TURNS_PER_GATHER`` turns with the existing
+``bit_step`` bit-plane kernel (the window's margins cover the whole
+block's dependency cone, and per-turn change accumulation keeps
+oscillators whose period divides the block depth active), scatters the
+interiors back, and recomputes the activity bitmap from the per-tile
+change flags. The gather capacity is padded to power-of-two buckets (the
+engine/sessions.py chunk-quantisation trick) so frontier churn keys at
+most log2(tiles) compiled programs, never one per frontier size; an
+in-flight overflow commits the turns already done and re-dispatches at
+the next bucket. Above the measured density crossover the plane routes
+the whole remaining chunk through the dense ``BitPlane`` path (the
+crossover point is where gather/scatter overhead exceeds the dense
+kernel's content-independent cost) and rebuilds the bitmap from tile
+occupancy afterwards.
+
+Steady states short-circuit arithmetically: an empty activity bitmap is
+a still life (the remaining turns of the call are no-ops, reported
+done), and a small surviving frontier is probed for period-2 cycles
+(board(t+2) == board(t)) — both mark the state ``steady`` so the engine
+can jump the rest of the run in O(1) (``gol_early_exit_total{kind}``).
+
+The bottom of the file is the WIRE-TILE toolkit: pure-numpy helpers the
+resident-strip workers and the delta-checkpoint layer share to turn a
+(before, after) board pair into a per-tile dirty bitmap, extract the
+dirty tiles into one flat sidecar buffer, and re-apply them onto a base
+board (rpc/worker.py StripStep/StripFetch deltas, engine/checkpoint.py
+delta checkpoints).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..models import CONWAY, LifeRule
+from ..obs import instruments as _ins
+from ..obs import metrics as _metrics
+
+# -- knobs (README "Sparse stepping") ----------------------------------------
+
+#: auto_plane routes boards at least this big through SparseBitPlane.
+#: Below it the tile grid is too coarse for the bitmap to pay for its
+#: upkeep: measured on CPU, a sparse R-pentomino at 1024² is a wash-to-
+#: loss (64 coarse tiles, half active), while 4096²+ wins whenever the
+#: board is actually sparse (the dense kernel's content-independent cost
+#: grows with area; the sparse loop's does not)
+SPARSE_MIN_CELLS = 4096 * 4096
+#: active-tile fraction above which step_n routes the chunk through the
+#: dense path: measured on CPU (and conservatively on v5e numbers), the
+#: per-tile gather/scatter + bitmap upkeep costs ~2-4x a dense tile's
+#: in-place step, so sparse stops winning near ~1/3 active
+SPARSE_DENSITY_CROSSOVER = 0.25
+#: probe the frontier for a period-2 cycle only while it is this small —
+#: the probe costs two single-turn dispatches plus one whole-board
+#: equality reduce per step_n call, which must stay negligible
+P2_PROBE_MAX_TILES = 64
+#: hard byte ceiling for one gather batch's halo-extended windows: past
+#: it the dense path is taken even below the density crossover (the
+#: bit-plane temporaries multiply the ext working set ~10x)
+_SPARSE_EXT_BUDGET = 256 << 20
+#: cap on while-loop blocks per dispatch: keeps the int32 active-tile
+#: accumulator exact (blocks x capacity < 2^31 at every supported board
+#: size) and bounds a single dispatch's wall; the host loop re-dispatches
+#: the remainder seamlessly
+_MAX_BLOCKS_PER_DISPATCH = 8192
+#: turns advanced per gather/scatter round: the ext window carries an
+#: H-cell column margin (the word-row margin is 32 cells already), so H
+#: turns evolve inside one gathered batch before anything is scattered
+#: back — amortising the per-turn launch overhead of the loop body H-fold
+#: (the resident wire's K-batching argument, applied inside the chip).
+#: Clamped per tile geometry: influence must stay within one tile ring.
+SPARSE_TURNS_PER_GATHER = 8
+
+#: wire/checkpoint delta tile geometry (cells) — the dirty-bitmap unit
+#: the resident workers report and the delta codecs ship
+WIRE_TILE_ROWS = 64
+WIRE_TILE_COLS = 256
+
+
+def _pow2_ceil(n: int) -> int:
+    n = max(1, int(n))
+    return 1 << (n - 1).bit_length()
+
+
+def sparse_capable(rule: LifeRule, shape: tuple[int, int]) -> bool:
+    """Whether the sparse plane may serve this (rule, geometry): rows
+    packable (H % 32), no birth-on-0 (a B0 rule births cells in fully
+    dead regions — the activity invariant does not hold), and the board
+    big enough to pay for bitmap upkeep. ``GOL_SPARSE=on`` drops the
+    size floor, ``GOL_SPARSE=off`` disables routing entirely (the knob
+    row in README "Sparse stepping")."""
+    mode = os.environ.get("GOL_SPARSE", "auto").lower()
+    if mode == "off":
+        return False
+    h, w = shape
+    if h % 32 != 0 or rule.birth_mask & 1:
+        return False
+    if mode == "on":
+        return True
+    return h * w >= SPARSE_MIN_CELLS
+
+
+class SparseState:
+    """The sparse plane's device state: the packed bitboard, the [GR, GC]
+    activity bitmap (device bool), the host-cached active-tile count, and
+    the steady-state verdict (``None`` / ``"still"`` / ``"period2"``,
+    with ``alt`` holding the opposite phase of a period-2 cycle)."""
+
+    __slots__ = ("packed", "grid", "count", "steady", "alt")
+
+    def __init__(self, packed, grid, count: int, steady: Optional[str] = None,
+                 alt=None):
+        self.packed = packed
+        self.grid = grid
+        self.count = int(count)
+        self.steady = steady
+        self.alt = alt
+
+    def block_until_ready(self):
+        # the engine's growth-chunk sync + pipeline drain call this on
+        # whatever the plane returned (engine/engine.py)
+        self.packed.block_until_ready()
+        return self
+
+
+@functools.lru_cache(maxsize=None)
+def _occupancy_program(shape: tuple[int, int], tr: int, tc: int):
+    """(packed) -> dilated per-tile occupancy bitmap: the conservative
+    initial active set (a tile with no live cell in itself or any
+    neighbour cannot change under a non-B0 rule), also the rebuild after
+    a dense chunk."""
+    import jax
+    import jax.numpy as jnp
+
+    rows, width = shape
+    gr, gc = rows // tr, width // tc
+
+    @jax.jit
+    def occupancy(packed):
+        occ = jnp.any(
+            packed.reshape(gr, tr, gc, tc) != 0, axis=(1, 3)
+        )
+        out = occ
+        for dy in (-1, 0, 1):
+            for dx in (-1, 0, 1):
+                if (dy, dx) != (0, 0):
+                    out = out | jnp.roll(occ, (dy, dx), axis=(0, 1))
+        return out
+
+    return occupancy
+
+
+def sparse_block_turns(tr: int, tc: int) -> int:
+    """Turns one gathered block may advance for a (word rows, cols) tile:
+    ``SPARSE_TURNS_PER_GATHER`` clamped so H turns of influence (H cells)
+    stay within one tile ring — the dilate-by-one active-set update is
+    exact only while H <= min(tile cell rows, tile cols, 32); the 32 is
+    the word-row halo margin."""
+    return max(1, min(SPARSE_TURNS_PER_GATHER, tr * 32, tc, 32))
+
+
+@functools.lru_cache(maxsize=None)
+def _sparse_program(
+    shape: tuple[int, int],
+    tr: int,
+    tc: int,
+    birth_mask: int,
+    survive_mask: int,
+    capacity: int,
+    h: int,
+):
+    """The one-dispatch sparse stepping loop for a packed shape, a
+    power-of-two gather capacity, and a block depth ``h`` (turns per
+    gather). ``(packed, grid, n_blocks) -> (packed, grid, blocks_done,
+    overflow, active_block_sum)``; ``n_blocks`` is a TRACED bound (the
+    loop lowers to while_loop), so only the (capacity bucket, h) pair
+    keys a compile — the jit-cache boundedness contract the
+    frontier-churn test pins."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from .bitpack import bit_step
+
+    rows, width = shape
+    gr, gc = rows // tr, width // tc
+
+    def one_block(packed, grid):
+        cnt = jnp.sum(grid, dtype=jnp.int32)
+        trow, tcol = jnp.nonzero(grid, size=capacity, fill_value=0)
+        # halo-extended windows, gathered ONCE per h-turn block: one word
+        # row (32 cells) of margin above/below and h cell columns each
+        # side — the dependency cone of h turns stays inside, so every
+        # intermediate interior is exact (the ops/pallas_tiled.py
+        # argument: the window's own cyclic rotate only contaminates a
+        # creeping border ring, which the interior slice never reaches
+        # while the step index <= margin). Torus wrap falls out of the
+        # modular window indexing. Padding entries (nonzero's fill)
+        # recompute tile 0 redundantly — always correct.
+        wr = (trow[:, None] * tr - 1 + jnp.arange(tr + 2)[None, :]) % rows
+        wc = (tcol[:, None] * tc - h + jnp.arange(tc + 2 * h)[None, :]) % width
+        ext = packed[wr[:, :, None], wc[:, None, :]]
+        inner = (slice(None), slice(1, -1), slice(h, -h))
+        old = ext[inner]
+        cur = ext
+        # h turns inside the gathered batch; `changed` accumulates PER
+        # TURN — an oscillator whose period divides h returns to its
+        # start state by block end, and a start-vs-end diff would wrongly
+        # freeze it
+        changed = jnp.zeros((capacity,), bool)
+        for _ in range(h):
+            nxt = jax.vmap(
+                lambda e: bit_step(
+                    e, 0, birth_mask=birth_mask, survive_mask=survive_mask
+                )
+            )(cur)
+            changed = changed | jnp.any(
+                nxt[inner] != cur[inner], axis=(1, 2)
+            )
+            cur = nxt
+        ok = cnt <= capacity
+        # an overflowing block must commit NOTHING: writing the old
+        # values back makes the scatter a no-op without an O(board)
+        # select
+        new = jnp.where(ok, cur[inner], old)
+        packed = packed.at[
+            wr[:, 1:-1, None], wc[:, None, h:-h]
+        ].set(new)
+        cgrid = jnp.zeros((gr, gc), bool).at[trow, tcol].max(changed)
+        dil = cgrid
+        for dy in (-1, 0, 1):
+            for dx in (-1, 0, 1):
+                if (dy, dx) != (0, 0):
+                    dil = dil | jnp.roll(cgrid, (dy, dx), axis=(0, 1))
+        grid = jnp.where(ok, dil, grid)
+        return packed, grid, cnt, ok
+
+    @jax.jit
+    def run(packed, grid, n_blocks):
+        def cond(carry):
+            _packed, grid, t, over, _act = carry
+            # stop early on overflow (host re-buckets) and on an EMPTY
+            # bitmap (still life: the remaining turns are no-ops the
+            # host counts as done)
+            return (t < n_blocks) & jnp.logical_not(over) & jnp.any(grid)
+
+        def body(carry):
+            packed, grid, t, over, act = carry
+            packed, grid, cnt, ok = one_block(packed, grid)
+            t = jnp.where(ok, t + 1, t)
+            # int32 accumulation is exact (float32 rounds past 2^24 and
+            # would silently skew the skip accounting on big boards);
+            # the host caps blocks-per-dispatch so blocks x capacity
+            # stays under 2^31
+            act = act + jnp.where(ok, cnt, 0)
+            return packed, grid, t, over | jnp.logical_not(ok), act
+
+        packed, grid, t, over, act = lax.while_loop(
+            cond,
+            body,
+            (packed, grid, jnp.int32(0), jnp.bool_(False), jnp.int32(0)),
+        )
+        return packed, grid, t, over, act
+
+    return run
+
+
+def compiled_program_count() -> int:
+    """How many sparse turn-loop programs have been compiled (one per
+    (shape, tile, rule, capacity-bucket)) — the frontier-churn jit-cache
+    boundedness gate reads this."""
+    return _sparse_program.cache_info().currsize
+
+
+class SparseBitPlane:
+    """Activity-sparse bitboard data plane (ops/plane.py interface plus
+    the early-exit protocol: ``steady_kind``/``fast_forward``). Dense
+    bit-exactness is the contract — the sparse path, the dense-crossover
+    path, and the steady-state jumps all land on the same bits as
+    ``BitPlane.step_n`` (tests/test_sparse.py pins it against the numpy
+    oracle across tile boundaries)."""
+
+    def __init__(
+        self,
+        rule: LifeRule = CONWAY,
+        tile: Optional[tuple[int, int]] = None,
+    ):
+        if rule.birth_mask & 1:
+            raise ValueError(
+                f"rule {rule.rulestring} births on 0 neighbours: the "
+                "activity invariant does not hold; use the dense plane"
+            )
+        from .plane import BitPlane
+
+        self.rule = rule
+        self.word_axis = 0  # rows packed: the activity tiles span word rows
+        self._tile = tile  # explicit (word_rows, cols) override, else picked
+        self._dense = BitPlane(rule, 0)
+
+    # -- geometry ---------------------------------------------------------
+
+    def _tiles_for(self, packed_shape: tuple[int, int]) -> tuple[int, int]:
+        if self._tile is not None:
+            tr, tc = self._tile
+            if packed_shape[0] % tr or packed_shape[1] % tc:
+                raise ValueError(
+                    f"tile {self._tile} does not divide packed shape "
+                    f"{packed_shape}"
+                )
+            return tr, tc
+        from .pallas_tiled import sparse_tile_shape
+
+        return sparse_tile_shape(packed_shape)
+
+    def _grid_state(self, packed) -> SparseState:
+        """Rebuild the activity bitmap from tile occupancy (encode, and
+        after any dense chunk)."""
+        import jax.numpy as jnp
+
+        tr, tc = self._tiles_for(tuple(packed.shape))
+        grid = _occupancy_program(tuple(packed.shape), tr, tc)(packed)
+        return SparseState(packed, grid, int(jnp.sum(grid)))
+
+    # -- plane interface --------------------------------------------------
+
+    def encode(self, board):
+        return self._grid_state(self._dense.encode(board))
+
+    def decode(self, state) -> np.ndarray:
+        return self._dense.decode(state.packed)
+
+    def alive_count(self, state) -> int:
+        return self._dense.alive_count(state.packed)
+
+    def alive_cells(self, state):
+        return self._dense.alive_cells(state.packed)
+
+    def step_n(self, state, n: int):
+        import jax.numpy as jnp
+
+        n = int(n)
+        if n <= 0:
+            return state
+        st = state
+        shape = tuple(st.packed.shape)
+        tr, tc = self._tiles_for(shape)
+        total = (shape[0] // tr) * (shape[1] // tc)
+        h_full = sparse_block_turns(tr, tc)
+        birth, survive = self.rule.birth_mask, self.rule.survive_mask
+        remaining = n
+        while remaining > 0:
+            if st.steady is not None:
+                return self.fast_forward(st, remaining)
+            capacity = min(_pow2_ceil(max(st.count, 8) * 2), _pow2_ceil(total))
+            ext_bytes = capacity * (tr + 2) * (tc + 2 * h_full) * 4
+            if (
+                st.count > SPARSE_DENSITY_CROSSOVER * total
+                or ext_bytes > _SPARSE_EXT_BUDGET
+            ):
+                # dense crossover: the whole remaining chunk through the
+                # dense kernel routing (pow2 pieces keep its static turn
+                # count quantised), then rebuild the bitmap from occupancy
+                packed = st.packed
+                left = remaining
+                while left > 0:
+                    piece = 1 << (left.bit_length() - 1)
+                    packed = self._dense.step_n(packed, piece)
+                    left -= piece
+                st = self._grid_state(packed)
+                remaining = 0
+                break
+            # h turns per gathered block; the tail under one full block
+            # runs at h=1 — (capacity, h) pairs bound the compile count
+            h_eff = h_full if remaining >= h_full else 1
+            n_units = min(remaining // h_eff, _MAX_BLOCKS_PER_DISPATCH)
+            program = _sparse_program(
+                shape, tr, tc, birth, survive, capacity, h_eff
+            )
+            packed, grid, t, over, act = program(
+                st.packed, st.grid, jnp.int32(n_units)
+            )
+            t = int(t)
+            turns_done = t * h_eff
+            count = int(jnp.sum(grid))
+            if _metrics.enabled():
+                _ins.ACTIVE_TILES.set(count)
+                if turns_done:
+                    _ins.TILE_SKIPS_TOTAL.inc(max(
+                        0, turns_done * total - int(act) * h_eff
+                    ))
+            remaining -= turns_done
+            if bool(over):
+                # frontier outgrew the bucket mid-loop: the blocks already
+                # done are committed; re-dispatch the rest one bucket up
+                st = SparseState(packed, grid, count)
+                continue
+            if t < n_units:
+                # the bitmap drained before the budget: still life — the
+                # remaining turns are no-ops, done by definition
+                st = SparseState(packed, grid, count, steady="still")
+                if _metrics.enabled():
+                    _ins.EARLY_EXIT_TOTAL.labels("still").inc()
+                return st
+            st = SparseState(packed, grid, count)
+        if st.steady is None and 0 < st.count <= P2_PROBE_MAX_TILES:
+            st = self._probe_period2(st, shape, tr, tc, birth, survive)
+        return st
+
+    def _probe_period2(self, st, shape, tr, tc, birth, survive):
+        """Two probe turns on a small frontier: if board(t+2) == board(t)
+        the run is blinker-stable and every later chunk is arithmetic.
+        The probe mutates nothing — a failed probe discards its states."""
+        import jax.numpy as jnp
+
+        capacity = _pow2_ceil(max(st.count, 8) * 2)
+        program = _sparse_program(shape, tr, tc, birth, survive, capacity, 1)
+        p1, g1, t1, o1, _ = program(st.packed, st.grid, jnp.int32(1))
+        if int(t1) != 1 or bool(o1):
+            return st
+        p2, _g2, t2, o2, _ = program(p1, g1, jnp.int32(1))
+        if int(t2) != 1 or bool(o2):
+            return st
+        if bool(jnp.all(p2 == st.packed)) and not bool(
+            jnp.all(p1 == st.packed)
+        ):
+            if _metrics.enabled():
+                _ins.EARLY_EXIT_TOTAL.labels("period2").inc()
+            return SparseState(
+                st.packed, st.grid, st.count, steady="period2", alt=p1
+            )
+        return st
+
+    # -- the early-exit protocol (ops/plane.py docstring) -----------------
+
+    def steady_kind(self, state) -> Optional[str]:
+        return state.steady
+
+    def fast_forward(self, state, k: int):
+        """``k`` turns of a steady state in O(1): a still life is itself,
+        a period-2 cycle lands on phase ``k % 2``."""
+        if state.steady == "period2" and int(k) % 2 == 1:
+            return SparseState(
+                state.alt, state.grid, state.count,
+                steady="period2", alt=state.packed,
+            )
+        return state
+
+    def from_packed(self, packed) -> SparseState:
+        """Adopt an existing packed bitboard (e.g. ``bigboard.seed_packed``
+        output) as a sparse state — the activity bitmap rebuilds from
+        tile occupancy, exactly like ``encode``'s."""
+        return self._grid_state(packed)
+
+    def active_fraction(self, state) -> float:
+        """Active tiles over total tiles — the sparsity figure the bench
+        embeds (``active_fraction`` on the sparse-board cases)."""
+        shape = tuple(state.packed.shape)
+        tr, tc = self._tiles_for(shape)
+        total = (shape[0] // tr) * (shape[1] // tc)
+        return state.count / total if total else 0.0
+
+
+def active_fraction_of(packed) -> float:
+    """Active-tile fraction of a bare packed bitboard under the default
+    tile geometry — what the bench stamps on the dense sparse-board
+    cases (``active_fraction``) without constructing a plane."""
+    plane = SparseBitPlane(CONWAY)
+    return plane.active_fraction(plane.from_packed(packed))
+
+
+# -- wire/checkpoint tile deltas (pure numpy) --------------------------------
+#
+# The dirty-tile unit the resident-strip workers report per StripStep, the
+# broker ships per delta sync (protocol-5 sidecar: one flat uint8 buffer +
+# the bool bitmap), and the delta checkpoints store. Tiles are a fixed
+# (WIRE_TILE_ROWS x WIRE_TILE_COLS) grid with ragged right/bottom edges, so
+# geometry is a pure function of the board shape — both ends derive it
+# independently and the flat buffer's layout is deterministic.
+
+
+def wire_tile_grid(
+    shape: tuple[int, int],
+    tile_rows: int = WIRE_TILE_ROWS,
+    tile_cols: int = WIRE_TILE_COLS,
+) -> tuple[int, int]:
+    """(tile grid rows, cols) for a board/strip shape — ceil division,
+    ragged edge tiles included."""
+    h, w = shape
+    return -(-h // tile_rows), -(-w // tile_cols)
+
+
+def dirty_tile_grid(
+    before: np.ndarray,
+    after: np.ndarray,
+    tile_rows: int = WIRE_TILE_ROWS,
+    tile_cols: int = WIRE_TILE_COLS,
+) -> np.ndarray:
+    """Per-tile change bitmap between two same-shape boards: bool
+    [grid_rows, grid_cols], True where any cell in the tile differs."""
+    if before.shape != after.shape:
+        raise ValueError(
+            f"dirty grid needs same shapes, got {before.shape} vs "
+            f"{after.shape}"
+        )
+    diff = before != after
+    h, w = diff.shape
+    rows = np.arange(0, h, tile_rows)
+    cols = np.arange(0, w, tile_cols)
+    return (
+        np.add.reduceat(
+            np.add.reduceat(diff.astype(np.int32), rows, axis=0),
+            cols,
+            axis=1,
+        )
+        > 0
+    )
+
+
+def _tile_bounds(shape, idx_r, idx_c, tile_rows, tile_cols):
+    h, w = shape
+    r0, c0 = idx_r * tile_rows, idx_c * tile_cols
+    return r0, min(r0 + tile_rows, h), c0, min(c0 + tile_cols, w)
+
+
+def extract_dirty_tiles(
+    board: np.ndarray,
+    dirty: np.ndarray,
+    tile_rows: int = WIRE_TILE_ROWS,
+    tile_cols: int = WIRE_TILE_COLS,
+) -> np.ndarray:
+    """The dirty tiles' bytes as ONE flat contiguous uint8 buffer, in
+    row-major dirty-bitmap order — the protocol-5 sidecar payload of a
+    delta frame. Deterministic layout: both ends derive tile bounds from
+    (shape, bitmap) alone."""
+    if dirty.shape != wire_tile_grid(board.shape, tile_rows, tile_cols):
+        raise ValueError(
+            f"dirty grid {dirty.shape} does not match board "
+            f"{board.shape} at tile ({tile_rows}, {tile_cols})"
+        )
+    parts = []
+    for idx_r, idx_c in zip(*np.nonzero(dirty)):
+        r0, r1, c0, c1 = _tile_bounds(
+            board.shape, idx_r, idx_c, tile_rows, tile_cols
+        )
+        parts.append(np.ascontiguousarray(board[r0:r1, c0:c1]).ravel())
+    if not parts:
+        return np.zeros(0, np.uint8)
+    return np.concatenate(parts).astype(np.uint8, copy=False)
+
+
+def apply_dirty_tiles(
+    base: np.ndarray,
+    dirty: np.ndarray,
+    flat: np.ndarray,
+    tile_rows: int = WIRE_TILE_ROWS,
+    tile_cols: int = WIRE_TILE_COLS,
+) -> np.ndarray:
+    """Reconstruct a board from ``base`` plus a dirty-tile delta (a COPY;
+    the base is never mutated). Raises ``ValueError`` on any geometry or
+    length mismatch — a malformed delta must never half-apply. Callers
+    that hold a digest of the intended result (the broker's committed
+    strip chain, a delta checkpoint's embedded digest) verify it AFTER
+    this, making delta application end-to-end safe."""
+    if dirty.shape != wire_tile_grid(base.shape, tile_rows, tile_cols):
+        raise ValueError(
+            f"dirty grid {dirty.shape} does not match base {base.shape} "
+            f"at tile ({tile_rows}, {tile_cols})"
+        )
+    flat = np.asarray(flat, np.uint8).ravel()
+    out = np.array(base, np.uint8, copy=True)
+    cursor = 0
+    for idx_r, idx_c in zip(*np.nonzero(dirty)):
+        r0, r1, c0, c1 = _tile_bounds(
+            base.shape, idx_r, idx_c, tile_rows, tile_cols
+        )
+        size = (r1 - r0) * (c1 - c0)
+        if cursor + size > flat.size:
+            raise ValueError(
+                f"delta payload truncated: needs >= {cursor + size} "
+                f"bytes, got {flat.size}"
+            )
+        out[r0:r1, c0:c1] = flat[cursor:cursor + size].reshape(
+            r1 - r0, c1 - c0
+        )
+        cursor += size
+    if cursor != flat.size:
+        raise ValueError(
+            f"delta payload has {flat.size - cursor} trailing bytes "
+            "beyond its dirty bitmap"
+        )
+    return out
+
+
+def _selfcheck() -> int:
+    """Oracle parity + early-exit smoke (scripts/check default path):
+    an R-pentomino crossing tile boundaries, a still life draining the
+    bitmap, an all-dead board, and a delta round-trip."""
+    h = w = 128
+    board = np.zeros((h, w), np.uint8)
+    for dx, dy in ((1, 0), (2, 0), (0, 1), (1, 1), (1, 2)):
+        board[h // 2 + dy, w // 2 + dx] = 255
+
+    def oracle(b, n):
+        ones = (b != 0).astype(np.int32)
+        for _ in range(n):
+            c = sum(
+                np.roll(np.roll(ones, dy, 0), dx, 1)
+                for dy in (-1, 0, 1)
+                for dx in (-1, 0, 1)
+                if (dy, dx) != (0, 0)
+            )
+            ones = ((c == 3) | ((ones == 1) & (c == 2))).astype(np.int32)
+        return (ones * 255).astype(np.uint8)
+
+    plane = SparseBitPlane(CONWAY, tile=(1, 16))
+    state = plane.step_n(plane.encode(board), 150)
+    if not np.array_equal(plane.decode(state), oracle(board, 150)):
+        print("sparse selfcheck: R-pentomino parity FAILED")
+        return 1
+    block = np.zeros((64, 64), np.uint8)
+    block[10:12, 10:12] = 255
+    still_plane = SparseBitPlane(CONWAY, tile=(1, 2))
+    st = still_plane.step_n(still_plane.encode(block), 50)
+    if st.steady != "still":
+        print("sparse selfcheck: still-life early exit FAILED")
+        return 1
+    dead = SparseBitPlane(CONWAY, tile=(1, 8))
+    std = dead.step_n(dead.encode(np.zeros((64, 64), np.uint8)), 10)
+    if dead.alive_count(std) != 0 or std.count != 0:
+        print("sparse selfcheck: all-dead FAILED")
+        return 1
+    after = oracle(board, 3)
+    dirty = dirty_tile_grid(board, after, 16, 16)
+    flat = extract_dirty_tiles(after, dirty, 16, 16)
+    if not np.array_equal(
+        apply_dirty_tiles(board, dirty, flat, 16, 16), after
+    ):
+        print("sparse selfcheck: delta round-trip FAILED")
+        return 1
+    print(
+        "sparse selfcheck ok: oracle parity (150 turns), still-life "
+        "early exit, all-dead, delta round-trip"
+    )
+    return 0
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="activity-sparse stepping utilities"
+    )
+    parser.add_argument(
+        "--selfcheck", action="store_true",
+        help="oracle parity + early-exit + delta round-trip smoke",
+    )
+    args = parser.parse_args(argv)
+    if args.selfcheck:
+        return _selfcheck()
+    parser.error("nothing to do (want --selfcheck)")
+    return 2
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

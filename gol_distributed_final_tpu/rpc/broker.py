@@ -72,6 +72,13 @@ _DEADLINE_GRACE = 2.0
 # gets quarantined exponentially instead of taxing every turn forever
 _PROBE_BACKOFF_CAP = 5.0
 _LOSS_BACKOFF_CAP = 60.0
+# dirty-tile delta bounds (ops/sparse.py wire tiles): every Nth resident
+# sync forces full frames even when deltas are available (a cheap
+# keyframe against accumulated skew), and every Nth auto-checkpoint is a
+# full generation the intervening deltas are cut against (each delta is
+# depth-1 from its keyframe — never a delta-on-delta chain)
+_KEYFRAME_SYNCS = 16
+_CKPT_KEYFRAME_EVERY = 8
 
 
 class TpuBackend:
@@ -271,6 +278,7 @@ class WorkersBackend:
         halo_depth: int = 1,
         sync_interval: int = 256,
         ckpt_keep: int = 1,
+        sparse_sync: bool = True,
     ):
         if wire not in ("haloed", "full", "resident"):
             raise ValueError(
@@ -303,6 +311,19 @@ class WorkersBackend:
         self._probe_interval = probe_interval
         self._turn_seconds: float | None = None  # EWMA, turn-loop-local
         self._last_ckpt = 0.0
+        # dirty-tile delta state, all turn-loop-local (like _turn_seconds):
+        # whether delta StripFetch syncs are enabled (-sparse-sync), the
+        # sync/checkpoint keyframe counters, the global dirty grid
+        # accumulated from StripStep replies since the last FULL
+        # auto-checkpoint (None = window unknown — a skewed worker or a
+        # fresh run — forcing the next checkpoint to a full keyframe),
+        # and that keyframe's (turn, digest) anchor
+        self._sparse_sync = sparse_sync
+        self._sync_count = 0
+        self._ckpt_count = 0
+        self._ckpt_dirty: np.ndarray | None = None
+        self._last_batch_dirty: np.ndarray | None = None
+        self._ckpt_base: tuple[int, str] | None = None
         # guards the roster maps (_GUARDED_BY); GOL_LOCKSAN swaps in the
         # instrumented wrapper (utils/locksan.py), plain Lock otherwise
         self._lock = _locksan.lock("WorkersBackend._lock")
@@ -752,14 +773,37 @@ class WorkersBackend:
         """Gather every resident strip (``StripFetch``) and refresh the
         broker's full board at the committed turn. True on success; False
         after marking failures — or lockstep-diverged strips — lost (the
-        caller then recovers and reseeds)."""
+        caller then recovers and reseeds).
+
+        With ``-sparse-sync`` (the default) the fetch asks each worker
+        for a dirty-tile DELTA against the full copy the broker already
+        holds from the last sync (``Request.delta_base_turn``): a
+        <1%-active board re-syncs in a fraction of the full-strip bytes
+        (``gol_sparse_frame_bytes_total``). A worker whose accumulator is
+        not anchored at that turn — version skew, a sync the broker
+        failed to apply, a reseed — replies with the full strip, and
+        every ``_KEYFRAME_SYNCS``-th sync forces full frames anyway. The
+        crc/adler machinery makes delta application SAFE: the
+        reconstructed strip must hash to the committed digest chain
+        exactly like a full fetch, so a wrong delta can only ever be a
+        loud loss, never an assembled board."""
+        from ..ops import sparse as _sparse
+
         with self._lock:
             turn = self._turn
+            base_world, base_turn = self._world, self._sync_turn
+        self._sync_count += 1
+        use_delta = (
+            self._sparse_sync
+            and base_world is not None
+            and self._sync_count % _KEYFRAME_SYNCS != 0
+        )
+        delta_base = base_turn if use_delta else -1
         deadline = self._scatter_deadline()
         futures = [
             pool.submit(
                 self._call_worker, c, Methods.STRIP_FETCH,
-                Request(worker=i), deadline, tp,
+                Request(worker=i, delta_base_turn=delta_base), deadline, tp,
             )
             for i, c in enumerate(plan.active)
         ]
@@ -768,11 +812,35 @@ class WorkersBackend:
         for i in dead:
             self._mark_lost(plan.active[i], "resident sync failed")
             ok = False
+        strips: list[np.ndarray | None] = [None] * len(plan.active)
         for i, res in enumerate(results):
             if res is None:
                 continue
             s, e = plan.bounds[i]
-            strip = np.asarray(res.work_slice, np.uint8)
+            dirty = getattr(res, "dirty", None)
+            if isinstance(dirty, np.ndarray):
+                # delta frame: reconstruct from the base rows + the flat
+                # tile payload; a malformed geometry is a protocol
+                # violation, handled like any other corrupt reply
+                payload = np.asarray(res.work_slice, np.uint8)
+                try:
+                    strip = _sparse.apply_dirty_tiles(
+                        np.asarray(base_world[s:e], np.uint8),
+                        np.asarray(dirty, bool),
+                        payload,
+                    )
+                except (ValueError, IndexError, TypeError):
+                    self._mark_lost(
+                        plan.active[i], "resident delta malformed"
+                    )
+                    ok = False
+                    continue
+                if _metrics.enabled():
+                    _ins.SPARSE_FRAME_BYTES_TOTAL.inc(
+                        payload.nbytes + dirty.size
+                    )
+            else:
+                strip = np.asarray(res.work_slice, np.uint8)
             if res.turns_completed != turn or strip.shape[0] != e - s:
                 # between batches every strip must sit at the committed
                 # turn — a divergence means this worker's session is not
@@ -780,8 +848,9 @@ class WorkersBackend:
                 self._mark_lost(plan.active[i], "resident lockstep divergence")
                 ok = False
             elif plan.digests[i] is not None and _integrity.enabled():
-                # the gathered bytes must hash to the committed chain: a
-                # strip corrupted since its last verified step must never
+                # the gathered (or delta-reconstructed) bytes must hash to
+                # the committed chain: a strip corrupted since its last
+                # verified step — or a wrongly-applied delta — must never
                 # be assembled into the broker's board
                 _ins.INTEGRITY_CHECKS_TOTAL.inc()
                 if _integrity.state_digest(strip) != plan.digests[i]:
@@ -794,12 +863,16 @@ class WorkersBackend:
                         plan.active[i], "resident fetch digest mismatch"
                     )
                     ok = False
+                else:
+                    strips[i] = strip
+            else:
+                strips[i] = strip
         if not ok:
             return False
         # concatenate copies out of the receive-buffer views (protocol-5
         # sidecars), so the world outlives the frames it arrived in
         world = np.concatenate(
-            [np.asarray(r.work_slice, np.uint8) for r in results], axis=0
+            [strips[i] for i in range(len(plan.active))], axis=0
         )
         with self._lock:
             self._world = world
@@ -1125,6 +1198,9 @@ class WorkersBackend:
                     with self._lock:
                         self._turn = turn0 + k
                         self._record_alive(turn0 + k, total)
+                    # the batch's dirty-tile bitmaps: the cluster-level
+                    # frontier gauge + the delta-checkpoint window
+                    self._note_batch_dirty(results, plan, h)
                     _ins.TURN_BATCH_SIZE.observe(k)
                     if attribution:
                         # per-addr StripStep walls + critical-path gating
@@ -1190,6 +1266,69 @@ class WorkersBackend:
         logger.error(
             "INTEGRITY violation (%s) from worker %s: %s", kind, addr, detail
         )
+
+    def _note_batch_dirty(self, results, plan, height: int) -> None:
+        """Fold one committed K-batch's per-strip dirty bitmaps
+        (``StripStep`` replies, ops/sparse.py wire tiles) into the
+        cluster frontier gauge and the global dirty grid the delta
+        auto-checkpoint cuts tiles from. A reply without the field — a
+        version-skewed worker — poisons the window: the next checkpoint
+        falls back to a full keyframe rather than trust a partial view.
+        Turn-loop-local state only; no lock needed."""
+        if not self._auto_checkpoint and not _metrics.enabled():
+            return  # nobody consumes the bitmaps: keep the hot loop clean
+        from ..ops.sparse import WIRE_TILE_ROWS, wire_tile_grid
+
+        total_dirty = 0
+        known = True
+        for res in results:
+            d = getattr(res, "dirty", None)
+            if isinstance(d, np.ndarray):
+                total_dirty += int(np.count_nonzero(d))
+            else:
+                known = False
+        if _metrics.enabled() and known:
+            _ins.ACTIVE_TILES.set(total_dirty)
+        if not self._auto_checkpoint:
+            return
+        if not known:
+            # window unknown -> the next write is a full keyframe
+            self._ckpt_dirty = None
+            self._last_batch_dirty = None
+            return
+        with self._lock:
+            world = self._world
+        width = world.shape[1] if world is not None else 0
+        grid_shape = wire_tile_grid((height, width))
+        batch_dirty = np.zeros(grid_shape, bool)
+        for i, res in enumerate(results):
+            d = getattr(res, "dirty", None)
+            s, e = plan.bounds[i]
+            tis, tjs = np.nonzero(d)
+            if not tis.size:
+                continue
+            # strip tile rows -> the global row bands they overlap
+            # (strips are full-width, so columns map 1:1). A strip tile
+            # is exactly WIRE_TILE_ROWS tall (ragged at the strip edge),
+            # so it spans at most TWO global bands — marking the first
+            # and last band covers the range, fully vectorized (the
+            # per-tile Python loop here measured as a real per-batch
+            # stall on big dirty grids)
+            r0 = s + tis * WIRE_TILE_ROWS
+            r1 = np.minimum(
+                s + np.minimum((tis + 1) * WIRE_TILE_ROWS, e - s), e
+            ) - 1
+            batch_dirty[r0 // WIRE_TILE_ROWS, tjs] = True
+            batch_dirty[r1 // WIRE_TILE_ROWS, tjs] = True
+        # the latest batch's own grid is kept separately: a full keyframe
+        # captures the world at its SYNC turn, and this batch's changes
+        # are already past it — they must seed the next window, not be
+        # zeroed with the old one (_maybe_auto_checkpoint)
+        self._last_batch_dirty = batch_dirty
+        if self._ckpt_dirty is not None and self._ckpt_dirty.shape == grid_shape:
+            self._ckpt_dirty |= batch_dirty
+        else:
+            self._ckpt_dirty = None
 
     def _ckpt_due(self) -> bool:
         """Whether the time-based auto-checkpoint wants to write — split
@@ -1319,7 +1458,16 @@ class WorkersBackend:
         engine/checkpoint.py byte-npz format, written tmp-then-rename so a
         crash mid-write leaves the previous checkpoint intact. Failures are
         logged, never fatal (the engine's checkpoint posture): a full disk
-        must not abort the run this snapshot exists to protect."""
+        must not abort the run this snapshot exists to protect.
+
+        In resident wire mode, between full keyframes the write is a
+        DELTA checkpoint: only the tiles the workers' StripStep dirty
+        bitmaps marked since the last full generation
+        (engine/checkpoint.save_delta_checkpoint — depth-1 against its
+        keyframe, verified end-to-end). Every ``_CKPT_KEYFRAME_EVERY``-th
+        write — and any write whose dirty window is unknown (fresh run,
+        a skewed worker, the scatter wires) — is a full generation that
+        clears the deltas and re-anchors the window."""
         if not self._auto_checkpoint:
             return
         secs, path = self._auto_checkpoint
@@ -1334,27 +1482,71 @@ class WorkersBackend:
             # a checkpoint must never pair a stale board with a newer turn
             world, turn = self._world, self._sync_turn
         from ..engine.checkpoint import (
+            checkpoint_digest,
+            clear_delta_checkpoints,
             npz_path,
             rotate_generations,
             save_checkpoint,
+            save_delta_checkpoint,
         )
         from ..models import CONWAY
+        from ..ops.sparse import wire_tile_grid
 
+        self._ckpt_count += 1
+        delta = (
+            self._ckpt_dirty is not None
+            and self._ckpt_base is not None
+            and self._ckpt_count % _CKPT_KEYFRAME_EVERY != 0
+            and world is not None
+            and self._ckpt_dirty.shape == wire_tile_grid(world.shape)
+            and turn > self._ckpt_base[0]
+        )
         try:
             p = pathlib.Path(path)
-            tmp = p.with_name(p.name + ".tmp")
             # CONWAY unconditionally: run() refused any other rule at entry
-            written = save_checkpoint(tmp, world, turn, CONWAY)
-            # -ckpt-keep N: shift current -> .g1 -> ... BEFORE the rename,
-            # so a later generation that still verifies survives a write
-            # (or a run) that corrupts the newest one
-            rotate_generations(p, self._ckpt_keep)
-            written.replace(npz_path(p))
+            if delta:
+                save_delta_checkpoint(
+                    p, world, self._ckpt_dirty, turn, CONWAY,
+                    self._ckpt_base[0], self._ckpt_base[1],
+                )
+                # the dirty window stays: it accumulates SINCE THE
+                # KEYFRAME, so every delta applies directly onto it
+            else:
+                tmp = p.with_name(p.name + ".tmp")
+                written = save_checkpoint(tmp, world, turn, CONWAY)
+                # -ckpt-keep N: shift current -> .g1 -> ... BEFORE the
+                # rename, so a later generation that still verifies
+                # survives a write (or a run) that corrupts the newest one
+                rotate_generations(p, self._ckpt_keep)
+                written.replace(npz_path(p))
+                # deltas were cut against the PREVIOUS keyframe: their
+                # base digest would refuse anyway, this keeps dir honest
+                clear_delta_checkpoints(p)
+                self._ckpt_base = (
+                    turn,
+                    checkpoint_digest(world, turn, CONWAY.rulestring),
+                )
+                # re-seed the window from the LATEST batch's dirty grid:
+                # the keyframe captured the world at its sync turn, and
+                # that batch's changes are already past it (zeroing here
+                # would lose them from the next delta)
+                if (
+                    self._wire == "resident"
+                    and world is not None
+                    and self._last_batch_dirty is not None
+                    and self._last_batch_dirty.shape
+                    == wire_tile_grid(world.shape)
+                ):
+                    self._ckpt_dirty = self._last_batch_dirty.copy()
+                else:
+                    # no (or skewed) batch dirty info: the window stays
+                    # unknown and the next write is another full keyframe
+                    self._ckpt_dirty = None
         except Exception as exc:
             logger.error("auto-checkpoint at turn %d failed: %s", turn, exc)
             return
         _ins.AUTO_CHECKPOINT_TOTAL.inc()
-        _flight.record("ckpt.auto", str(p), turn=turn)
+        _flight.record("ckpt.auto", str(p), turn=turn, delta=bool(delta))
 
     def worker_health(self) -> list[dict]:
         """Per-address roster health for the Status payload (rendered as
@@ -2015,6 +2207,7 @@ def serve(
     sync_interval: int = 256,
     ckpt_keep: int = 1,
     session_capacity: int = 256,
+    sparse_sync: bool = True,
 ) -> tuple[RpcServer, BrokerService]:
     server = RpcServer(host=host, port=port)
     impl = (
@@ -2027,6 +2220,7 @@ def serve(
             halo_depth=halo_depth,
             sync_interval=sync_interval,
             ckpt_keep=ckpt_keep,
+            sparse_sync=sparse_sync,
         )
         if backend == "workers"
         else TpuBackend(halo_depth=halo_depth)
@@ -2082,6 +2276,15 @@ def main(argv=None) -> None:
              "re-syncs (bounds the local recompute a loss recovery pays; "
              "0 = only at snapshot/pause/checkpoint/run-end boundaries "
              "and losses)",
+    )
+    parser.add_argument(
+        "-sparse-sync", dest="sparse_sync", choices=("on", "off"),
+        default="on",
+        help="-wire resident: dirty-tile delta StripFetch syncs "
+             "(ops/sparse.py wire tiles) — full gathers ship only the "
+             "tiles that changed since the broker's last full copy, "
+             "digest-verified against the committed strip chain; every "
+             "16th sync is a full keyframe. off: always full frames",
     )
     parser.add_argument(
         "-rpc-deadline", dest="rpc_deadline", type=float, default=0.0,
@@ -2225,6 +2428,8 @@ def main(argv=None) -> None:
         )
     if args.sync_interval != 256 and args.wire != "resident":
         parser.error("-sync-interval is a -wire resident knob")
+    if args.sparse_sync != "on" and args.wire != "resident":
+        parser.error("-sparse-sync is a -wire resident knob")
     if args.rpc_deadline < 0:
         parser.error(f"-rpc-deadline must be >= 0, got {args.rpc_deadline}")
     if args.probe_interval <= 0:
@@ -2290,6 +2495,7 @@ def main(argv=None) -> None:
         sync_interval=args.sync_interval,
         ckpt_keep=args.ckpt_keep,
         session_capacity=args.session_capacity,
+        sparse_sync=args.sparse_sync == "on",
     )
     print(f"broker listening on :{server.port} (backend={args.backend})", flush=True)
     canary = None

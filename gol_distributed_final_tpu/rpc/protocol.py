@@ -125,6 +125,14 @@ class Request:
     # ships only tenants that changed since (totals always ride). Same
     # skew posture: getattr, absent/0 = the full (bounded) ledger.
     accounting_since: int = 0
+    # extension: dirty-tile delta StripFetch (ops/sparse.py wire tiles).
+    # The broker asks for a DELTA against the full strip copy it holds at
+    # this turn; a worker whose dirty accumulator is anchored at exactly
+    # that turn replies with only the tiles that changed since
+    # (Response.dirty + the flat tile buffer), anything else replies with
+    # the full strip. -1 (and a version-skewed older broker's pickle,
+    # via getattr) = full fetch, the pre-delta wire behavior.
+    delta_base_turn: int = -1
 
 
 @dataclasses.dataclass
@@ -168,6 +176,16 @@ class Response:
     # Readers use getattr: an older worker's pickle lacks it and 0.0
     # degrades the split to "whole round trip counted as wire+compute".
     service_seconds: float = 0.0
+    # extension: the per-tile dirty bitmap of the resident strip
+    # (ops/sparse.py wire tiles — bool [grid_rows, grid_cols]). On a
+    # StripStep reply it covers THIS batch's changes (the broker's
+    # frontier/checkpoint-delta feed); on a StripFetch reply its
+    # presence marks a DELTA frame whose dirty tiles ride in
+    # ``work_slice`` as one flat uint8 sidecar buffer instead of the
+    # full strip. Readers use getattr + isinstance: absent on a
+    # version-skewed or pre-delta peer's pickle — skew degrades to
+    # "full frames", never an AttributeError.
+    dirty: Optional[np.ndarray] = None
 
 
 # -- deserialisation allowlist ----------------------------------------------

@@ -136,6 +136,8 @@ class WorkerService:
         "_strip": "_strip_lock",
         "_strip_turn": "_strip_lock",
         "_strip_index": "_strip_lock",
+        "_strip_dirty": "_strip_lock",
+        "_strip_clean_turn": "_strip_lock",
     }
 
     def __init__(self, server: RpcServer):
@@ -149,6 +151,14 @@ class WorkerService:
         self._strip: np.ndarray | None = None
         self._strip_turn = 0
         self._strip_index = 0
+        # dirty-tile accumulator (ops/sparse.py wire tiles): which tiles
+        # changed since the broker last held a full copy of this strip.
+        # Anchored by _strip_clean_turn — the turn the accumulator was
+        # last reset at (seed, or any StripFetch reply); a delta fetch is
+        # only answered when the broker's base turn matches the anchor,
+        # anything else degrades to a full frame.
+        self._strip_dirty: np.ndarray | None = None
+        self._strip_clean_turn = 0
 
     def update(self, req: Request) -> Response:
         # chaos hook (rpc/faults.py): GOL_FAULT_POINTS can wedge, crash, or
@@ -183,10 +193,16 @@ class WorkerService:
         # out-of-band), whose lifetime is the frame's, not the session's
         if strip.ndim != 2 or strip.shape[0] < 1:
             raise ValueError(f"strip must be a 2-D row block, got {strip.shape}")
+        from ..ops.sparse import wire_tile_grid
+
         with self._strip_lock:
             self._strip = strip
             turn = self._strip_turn = getattr(req, "initial_turn", 0)
             self._strip_index = req.worker
+            # the broker just sent this full strip, so its copy IS
+            # current: a clean dirty accumulator anchored at the seed turn
+            self._strip_dirty = np.zeros(wire_tile_grid(strip.shape), bool)
+            self._strip_clean_turn = turn
         # reply with the turn captured UNDER the lock: a concurrent
         # StripStep landing between release and reply must not make this
         # seed acknowledgment claim the stepped turn (analysis/locks.py
@@ -234,6 +250,7 @@ class WorkerService:
             _faults.fault_point("worker.strip_corrupt", target=self._strip)
             check = _integrity.enabled()
             pre = _integrity.state_digest(self._strip) if check else None
+            pre_strip = self._strip
             if check:
                 strip, counts, att_top, att_bottom = strip_step_batch(
                     self._strip, halos[:k], halos[k:], k, attest=True
@@ -242,6 +259,21 @@ class WorkerService:
                 strip, counts = strip_step_batch(
                     self._strip, halos[:k], halos[k:], k
                 )
+            # per-tile dirty bitmap over the batch (ops/sparse.py wire
+            # tiles): rides the reply (the broker's frontier gauge +
+            # delta-checkpoint feed) and accumulates locally so a later
+            # StripFetch can ship only the tiles that changed since the
+            # broker's last full copy
+            from ..ops.sparse import dirty_tile_grid
+
+            dirty = dirty_tile_grid(pre_strip, strip)
+            if (
+                self._strip_dirty is not None
+                and self._strip_dirty.shape == dirty.shape
+            ):
+                self._strip_dirty |= dirty
+            else:
+                self._strip_dirty = dirty.copy()
             self._strip = strip
             self._strip_turn += k
             # the fresh boundary rows: the broker relays them to this
@@ -269,18 +301,53 @@ class WorkerService:
                 edges=edges,
                 counts=counts,
                 digests=digests,
+                dirty=dirty,
                 service_seconds=time.monotonic() - t0,
             )
 
     def strip_fetch(self, req: Request) -> Response:
         """Read the resident strip + its turn back out (full re-syncs,
-        snapshots, loss recovery)."""
+        snapshots, loss recovery).
+
+        When the broker passes ``delta_base_turn`` matching this strip's
+        dirty-accumulator anchor, the reply is a DELTA frame: the dirty
+        bitmap plus only the changed tiles as one flat sidecar buffer
+        (``ops/sparse.extract_dirty_tiles`` layout) — a <1%-active board
+        syncs in a fraction of the full-strip bytes. Any mismatch (a
+        version-skewed broker, a sync the broker failed to apply, a
+        reseed) degrades to the full frame; either way the accumulator
+        re-anchors at the current turn, and a broker that DIDN'T apply
+        the reply simply fails the anchor match next time — delta state
+        is self-healing, never trusted."""
+        base_turn = getattr(req, "delta_base_turn", -1)
         with self._strip_lock:
             if self._strip is None:
                 raise ValueError("no resident strip to fetch")
+            delta_ok = (
+                isinstance(base_turn, int)
+                and base_turn >= 0
+                and self._strip_dirty is not None
+                and base_turn == self._strip_clean_turn
+            )
+            if delta_ok:
+                from ..ops.sparse import extract_dirty_tiles
+
+                dirty = self._strip_dirty
+                flat = extract_dirty_tiles(self._strip, dirty)
+                self._strip_dirty = np.zeros_like(dirty)
+                self._strip_clean_turn = self._strip_turn
+                return Response(
+                    worker=self._strip_index,
+                    turns_completed=self._strip_turn,
+                    work_slice=flat,
+                    dirty=dirty,
+                )
             # the reference itself is safe to ship: StripStep REPLACES the
             # array (never mutates in place), so a concurrent step cannot
             # change these bytes under the serialiser
+            if self._strip_dirty is not None:
+                self._strip_dirty = np.zeros_like(self._strip_dirty)
+            self._strip_clean_turn = self._strip_turn
             return Response(
                 worker=self._strip_index,
                 turns_completed=self._strip_turn,

@@ -244,18 +244,22 @@ def test_admission_rejects_at_capacity_geometry_turns(live_metrics):
 def test_mid_batch_leave_frees_slot_without_stalling(live_metrics):
     """Differing budgets: the 4-turn universe finishes first, its slot
     compacts away (the device batch shrinks), and the survivors keep
-    advancing — bit-identical to their sequential runs throughout."""
+    advancing — bit-identical to their sequential runs throughout. The
+    all-dead universe 0 additionally EARLY-RETIRES at the same boundary
+    (its alive count demuxed to 0, so the rest of its budget is credited
+    arithmetically — gol_early_exit_total{kind="dead"})."""
     from gol_distributed_final_tpu.engine.sessions import SessionTable
 
     boards = _mixed_batch(b=3, h=32, w=32, seed=5)
     table = SessionTable(CONWAY, (32, 32), capacity=4)
-    s_a = table.admit(boards[0], 5)
+    s_a = table.admit(boards[0], 5)  # all-dead: early-retires
     s_b = table.admit(boards[1], 4)
     s_c = table.admit(boards[2], 9)
-    remaining = table.advance()  # k = 4: the smallest budget finishes
-    assert s_b.done.is_set() and not s_a.done.is_set() and not s_c.done.is_set()
-    assert remaining == 2
-    assert len(table._active) == 2 and table._state.shape[0] == 2
+    remaining = table.advance()  # k = 4: smallest budget AND the dead
+    assert s_b.done.is_set() and s_a.done.is_set()
+    assert not s_c.done.is_set()
+    assert remaining == 1
+    assert len(table._active) == 1 and table._state.shape[0] == 1
     assert np.array_equal(s_b.result, _seq(boards[1], 4))
     n = 0
     while table.advance():
@@ -265,8 +269,10 @@ def test_mid_batch_leave_frees_slot_without_stalling(live_metrics):
     assert np.array_equal(s_a.result, _seq(boards[0], 5))
     assert np.array_equal(s_c.result, _seq(boards[2], 9))
     assert _metric("gol_sessions_active") == 0
-    # universe-turns: 3 sessions x 4 turns, then 2 x 1, then 1 x 4
-    assert _metric("gol_session_turns_total") == 3 * 4 + 2 * 1 + 1 * 4
+    assert _metric("gol_early_exit_total", ("dead",)) == 1
+    # universe-turns COMPUTED (the dead universe's credited fifth turn
+    # is arithmetic, never dispatched): 3 x 4, then s_c alone 4 + 1
+    assert _metric("gol_session_turns_total") == 3 * 4 + 1 * 4 + 1 * 1
 
 
 def test_cancel_is_a_mid_batch_leave():
@@ -307,12 +313,16 @@ def test_per_session_event_demux_exactness():
     while table.advance():
         pass
     # chunk boundaries with power-of-two quantisation for heterogeneous
-    # budgets: k=2 (all, min 3 -> pow2 2), k=1 (min is 1), k=2, k=4
+    # budgets: k=2 (all, min 3 -> pow2 2). The all-dead universe 0 then
+    # early-retires (count demuxed to 0: its final three turns are
+    # credited arithmetically, no further ticks), so the remaining
+    # boundaries come from budgets (3, 9): k=1 (min is 1), k=4, k=2.
+    expected = {0: [2], 1: [2, 3], 2: [2, 3, 7, 9]}
     for i, budget in enumerate((5, 3, 9)):
         ticks = [e for e in events[i] if isinstance(e, AliveCellsCount)]
         turns = [e for e in events[i] if isinstance(e, TurnComplete)]
         finals = [e for e in events[i] if isinstance(e, FinalTurnComplete)]
-        expected_turns = [t for t in (2, 3, 5, 9) if t <= budget]
+        expected_turns = expected[i]
         assert [e.completed_turns for e in ticks] == expected_turns
         assert [e.completed_turns for e in turns] == expected_turns
         for e in ticks:  # count exactness vs the oracle at that turn

@@ -1,0 +1,554 @@
+"""Activity-sparse stepping suite (ISSUE 14, `scripts/check --sparse`).
+
+Covers the four layers of the sparse tentpole:
+
+* ``ops/sparse.SparseBitPlane`` — numpy-oracle BIT-IDENTICAL parity
+  across tile-boundary crossings (R-pentomino, Gosper glider gun, a
+  torus-wrapping glider, all-dead, a dense soup through the crossover
+  path), capacity-bucket overflow/regrowth, and jit-cache boundedness
+  under 100 varying-activity steps.
+* early exits — still-life / period-2 exactness through the ENGINE
+  (turn count, final board, PGM golden) and the metrics contract.
+* dirty-tile wire deltas — worker-level delta/full StripFetch protocol,
+  the live resident-cluster byte contract (delta sync ≥ 10× below a
+  full gather on a <1%-active board), and delta-application failure
+  modes.
+* delta checkpoints — round-trip through ``load_resume_checkpoint``,
+  corrupted-delta refusal, wrong-base refusal.
+
+Plus the satellite gates: obs/regress.py's per-active-cell and
+sparse-byte verdicts, auto_plane routing knobs, and the SPARSITY panel.
+"""
+
+import numpy as np
+import pytest
+
+from gol_distributed_final_tpu.models import CONWAY, LifeRule
+from gol_distributed_final_tpu.obs import metrics as obs_metrics
+from gol_distributed_final_tpu.ops import sparse as sparse_mod
+from gol_distributed_final_tpu.ops.sparse import (
+    SparseBitPlane,
+    apply_dirty_tiles,
+    dirty_tile_grid,
+    extract_dirty_tiles,
+    sparse_capable,
+    wire_tile_grid,
+)
+
+from oracle import vector_step
+
+
+def _oracle_n(board, n):
+    for _ in range(n):
+        board = vector_step(board)
+    return board
+
+
+def _r_pentomino(h, w):
+    board = np.zeros((h, w), np.uint8)
+    for dx, dy in ((1, 0), (2, 0), (0, 1), (1, 1), (1, 2)):
+        board[h // 2 + dy, w // 2 + dx] = 255
+    return board
+
+
+def _glider(h, w, y=1, x=1):
+    board = np.zeros((h, w), np.uint8)
+    for dy, dx in ((0, 1), (1, 2), (2, 0), (2, 1), (2, 2)):
+        board[(y + dy) % h, (x + dx) % w] = 255
+    return board
+
+
+def _gosper_gun(h, w):
+    cells = [
+        (5, 1), (5, 2), (6, 1), (6, 2), (5, 11), (6, 11), (7, 11),
+        (4, 12), (8, 12), (3, 13), (9, 13), (3, 14), (9, 14), (6, 15),
+        (4, 16), (8, 16), (5, 17), (6, 17), (7, 17), (6, 18), (3, 21),
+        (4, 21), (5, 21), (3, 22), (4, 22), (5, 22), (2, 23), (6, 23),
+        (1, 25), (2, 25), (6, 25), (7, 25), (3, 35), (4, 35), (3, 36),
+        (4, 36),
+    ]
+    board = np.zeros((h, w), np.uint8)
+    for y, x in cells:
+        board[y, x] = 255
+    return board
+
+
+@pytest.fixture
+def live_metrics():
+    obs_metrics.enable()
+    obs_metrics.registry().reset()
+    yield obs_metrics
+    obs_metrics.enable(False)
+    obs_metrics.registry().reset()
+
+
+def _metric(name, labels=()):
+    for fam in obs_metrics.registry().snapshot()["families"]:
+        if fam["name"] == name:
+            for s in fam["series"]:
+                if tuple(s.get("labels", ())) == tuple(labels):
+                    return s.get("value", 0)
+    return 0
+
+
+# -- oracle bit-parity across tile boundaries --------------------------------
+
+
+def test_r_pentomino_parity_crosses_tile_boundaries():
+    """The methuselah outgrows its seed tiles (capacity buckets overflow
+    and regrow along the way) and every bit matches the oracle."""
+    board = _r_pentomino(256, 256)
+    plane = SparseBitPlane(CONWAY, tile=(1, 16))  # 8x16 = 128 tiles
+    state = plane.encode(board)
+    seed_count = state.count
+    state = plane.step_n(state, 300)
+    assert np.array_equal(plane.decode(state), _oracle_n(board, 300))
+    assert state.count > seed_count  # the frontier genuinely spread
+
+
+def test_glider_gun_parity():
+    board = _gosper_gun(128, 128)
+    plane = SparseBitPlane(CONWAY, tile=(1, 16))
+    state = plane.step_n(plane.encode(board), 200)
+    assert np.array_equal(plane.decode(state), _oracle_n(board, 200))
+
+
+def test_glider_wraps_torus_across_tiles():
+    board = _glider(64, 64, y=60, x=60)  # launched into the wrap corner
+    plane = SparseBitPlane(CONWAY, tile=(1, 8))
+    state = plane.step_n(plane.encode(board), 250)
+    assert np.array_equal(plane.decode(state), _oracle_n(board, 250))
+
+
+def test_all_dead_board_is_free_and_still():
+    plane = SparseBitPlane(CONWAY, tile=(1, 8))
+    state = plane.encode(np.zeros((64, 64), np.uint8))
+    assert state.count == 0
+    state = plane.step_n(state, 1000)
+    assert plane.alive_count(state) == 0
+    assert state.steady == "still"
+
+
+def test_dense_soup_takes_crossover_path_bit_identical():
+    """A 30% soup is far past the density crossover: step_n must route
+    through the dense path and STILL match the oracle bit for bit."""
+    rng = np.random.default_rng(3)
+    board = np.where(rng.random((64, 64)) < 0.3, 255, 0).astype(np.uint8)
+    plane = SparseBitPlane(CONWAY, tile=(1, 8))
+    state = plane.encode(board)
+    assert state.count > sparse_mod.SPARSE_DENSITY_CROSSOVER * 2 * 8
+    state = plane.step_n(state, 40)
+    assert np.array_equal(plane.decode(state), _oracle_n(board, 40))
+
+
+def test_b0_rule_refused():
+    with pytest.raises(ValueError, match="births on 0"):
+        SparseBitPlane(LifeRule.from_rulestring("B0/S23"))
+
+
+def test_sparse_capable_routing(monkeypatch):
+    monkeypatch.delenv("GOL_SPARSE", raising=False)
+    assert not sparse_capable(CONWAY, (64, 64))  # below the size floor
+    assert sparse_capable(CONWAY, (4096, 4096))
+    assert not sparse_capable(CONWAY, (4097, 4096))  # rows not packable
+    monkeypatch.setenv("GOL_SPARSE", "on")
+    assert sparse_capable(CONWAY, (64, 64))
+    monkeypatch.setenv("GOL_SPARSE", "off")
+    assert not sparse_capable(CONWAY, (4096, 4096))
+
+
+def test_auto_plane_selects_sparse(monkeypatch):
+    from gol_distributed_final_tpu.ops import auto
+
+    monkeypatch.setenv("GOL_SPARSE", "on")
+    auto._PLANE_CACHE.pop((CONWAY.rulestring, (96, 96)), None)
+    plane = auto.auto_plane(CONWAY, (96, 96))
+    assert isinstance(plane, SparseBitPlane)
+    auto._PLANE_CACHE.pop((CONWAY.rulestring, (96, 96)), None)
+    monkeypatch.setenv("GOL_SPARSE", "off")
+    plane = auto.auto_plane(CONWAY, (96, 96))
+    assert not isinstance(plane, SparseBitPlane)
+    auto._PLANE_CACHE.pop((CONWAY.rulestring, (96, 96)), None)
+
+
+# -- jit-cache boundedness under frontier churn ------------------------------
+
+
+def test_frontier_churn_keeps_compile_count_bounded():
+    """100 steps of a growing/shrinking soup: the compiled-program count
+    may only move by the number of power-of-two capacity buckets — never
+    one program per frontier size."""
+    rng = np.random.default_rng(11)
+    board = np.zeros((256, 256), np.uint8)
+    board[96:160, 96:160] = np.where(
+        rng.random((64, 64)) < 0.35, 255, 0
+    ).astype(np.uint8)
+    plane = SparseBitPlane(CONWAY, tile=(1, 16))
+    state = plane.encode(board)
+    before = sparse_mod.compiled_program_count()
+    counts = set()
+    for _ in range(100):
+        state = plane.step_n(state, 1)
+        counts.add(state.count)
+    grew = sparse_mod.compiled_program_count() - before
+    total_tiles = 8 * 16
+    max_buckets = total_tiles.bit_length() + 2
+    assert len(counts) > 5, "the frontier must actually churn"
+    assert grew <= max_buckets, (
+        f"{grew} programs compiled for {len(counts)} distinct frontier "
+        f"sizes — the pow2 bucket contract is broken"
+    )
+    assert np.array_equal(plane.decode(state), _oracle_n(board, 100))
+
+
+# -- early exits through the engine ------------------------------------------
+
+
+def test_engine_still_life_early_exit_exact(live_metrics, tmp_path):
+    """A block run for 5000 turns: exact turn count, exact final board
+    (PGM golden), and the still-life early exit metered."""
+    from gol_distributed_final_tpu.engine.engine import Engine
+    from gol_distributed_final_tpu.io.pgm import read_pgm, write_pgm
+    from gol_distributed_final_tpu.params import Params
+
+    board = np.zeros((64, 64), np.uint8)
+    board[30:32, 30:32] = 255
+    plane = SparseBitPlane(CONWAY, tile=(1, 2))
+    result = Engine().run(
+        Params(turns=5000, image_width=64, image_height=64),
+        board,
+        plane=plane,
+    )
+    assert result.turns_completed == 5000
+    assert np.array_equal(result.world, board)  # a block is a block
+    # PGM golden: the run's final frame equals the oracle's, byte for byte
+    golden = tmp_path / "golden.pgm"
+    final = tmp_path / "final.pgm"
+    write_pgm(golden, _oracle_n(board, 5000))
+    write_pgm(final, result.world)
+    assert golden.read_bytes() == final.read_bytes()
+    assert _metric("gol_early_exit_total", ("still",)) >= 1
+
+
+@pytest.mark.parametrize("turns", [400, 401])
+def test_engine_period2_early_exit_exact(live_metrics, turns):
+    """A blinker run to an even AND an odd horizon: the period-2 jump
+    must land on the right phase both ways."""
+    from gol_distributed_final_tpu.engine.engine import Engine
+    from gol_distributed_final_tpu.params import Params
+
+    board = np.zeros((64, 64), np.uint8)
+    board[20, 19:22] = 255  # horizontal blinker
+    plane = SparseBitPlane(CONWAY, tile=(1, 2))
+    result = Engine().run(
+        Params(turns=turns, image_width=64, image_height=64),
+        board,
+        plane=plane,
+    )
+    assert result.turns_completed == turns
+    assert np.array_equal(result.world, _oracle_n(board, turns))
+    assert _metric("gol_early_exit_total", ("period2",)) >= 1
+
+
+def test_session_dead_universe_early_retire(live_metrics):
+    """The satellite: an all-dead universe with a huge budget retires at
+    the FIRST advance boundary with full FinalTurnComplete semantics."""
+    from gol_distributed_final_tpu.engine.sessions import SessionTable
+    from gol_distributed_final_tpu.events import FinalTurnComplete
+
+    events = []
+    table = SessionTable(CONWAY, (32, 32), capacity=2, max_chunk=4)
+    dead = table.admit(
+        np.zeros((32, 32), np.uint8), 100_000, on_event=events.append
+    )
+    glider = table.admit(_glider(32, 32), 8)
+    n = 0
+    while table.advance():
+        n += 1
+        assert n < 10, "the dead universe must not burn its budget"
+    assert dead.done.is_set() and dead.turns_done == 100_000
+    assert dead.alive_count == 0
+    assert np.array_equal(dead.result, np.zeros((32, 32), np.uint8))
+    finals = [e for e in events if isinstance(e, FinalTurnComplete)]
+    assert len(finals) == 1
+    assert finals[0].completed_turns == 100_000 and finals[0].alive == []
+    assert glider.done.is_set() and glider.turns_done == 8
+    assert _metric("gol_early_exit_total", ("dead",)) == 1
+
+
+# -- dirty-tile wire deltas --------------------------------------------------
+
+
+def test_tile_delta_roundtrip_ragged_edges():
+    rng = np.random.default_rng(5)
+    a = np.where(rng.random((100, 300)) < 0.2, 255, 0).astype(np.uint8)
+    b = a.copy()
+    b[0:3, 0:3] ^= 255          # top-left tile
+    b[97:100, 290:300] ^= 255   # ragged bottom-right tile
+    b[80, 120] ^= 255           # a ragged bottom-left tile
+    dirty = dirty_tile_grid(a, b)
+    assert dirty.shape == wire_tile_grid((100, 300))
+    assert int(dirty.sum()) == 3
+    flat = extract_dirty_tiles(b, dirty)
+    assert np.array_equal(apply_dirty_tiles(a, dirty, flat), b)
+    # malformed payloads must refuse loudly, never half-apply
+    with pytest.raises(ValueError, match="truncated"):
+        apply_dirty_tiles(a, dirty, flat[:-1])
+    with pytest.raises(ValueError, match="trailing"):
+        apply_dirty_tiles(a, dirty, np.concatenate([flat, flat[:1]]))
+
+
+def test_worker_strip_fetch_delta_protocol():
+    """Worker-level contract: StripStep accumulates dirty tiles; a fetch
+    whose base turn matches the anchor gets a delta, anything else a
+    full frame; the accumulator re-anchors either way."""
+    from gol_distributed_final_tpu.rpc.protocol import Request
+    from gol_distributed_final_tpu.rpc.worker import (
+        WorkerService,
+        compute_strip,
+    )
+
+    service = WorkerService(server=None)
+    board = _r_pentomino(96, 128)
+    service.strip_start(Request(world=board, worker=0, initial_turn=0))
+    halos = np.concatenate([board[-1:], board[:1]], axis=0)
+    res = service.strip_step(
+        Request(world=halos, worker=0, turns=1, initial_turn=0)
+    )
+    assert isinstance(res.dirty, np.ndarray) and res.dirty.any()
+    want = compute_strip(board, 0, 96)
+
+    # mismatched base -> full frame, accumulator re-anchored at turn 1
+    full = service.strip_fetch(Request(worker=0, delta_base_turn=999))
+    assert getattr(full, "dirty", None) is None
+    assert np.array_equal(np.asarray(full.work_slice), want)
+
+    # advance again; now the broker's copy is anchored at turn 1
+    halos = np.concatenate([want[-1:], want[:1]], axis=0)
+    service.strip_step(
+        Request(world=halos, worker=0, turns=1, initial_turn=1)
+    )
+    delta = service.strip_fetch(Request(worker=0, delta_base_turn=1))
+    assert isinstance(delta.dirty, np.ndarray)
+    want2 = compute_strip(want, 0, 96)
+    rebuilt = apply_dirty_tiles(
+        want, delta.dirty, np.asarray(delta.work_slice)
+    )
+    assert np.array_equal(rebuilt, want2)
+    # a delta frame must be smaller than the strip it replaces
+    assert np.asarray(delta.work_slice).nbytes < want2.nbytes
+
+    # a skew-shaped fetch (no delta_base_turn at all) stays full
+    legacy = service.strip_fetch(Request(worker=0))
+    assert getattr(legacy, "dirty", None) is None
+    assert np.array_equal(np.asarray(legacy.work_slice), want2)
+
+
+def test_resident_delta_sync_live_cluster_byte_contract(live_metrics):
+    """The live contract on a <1%-active 1024² board (the bench runs the
+    16384² version): a delta sync ships ≥ 10× fewer StripFetch bytes
+    than a full gather, bit-identical both ways."""
+    from gol_distributed_final_tpu.rpc import worker as rpc_worker
+    from gol_distributed_final_tpu.rpc.broker import WorkersBackend
+    from gol_distributed_final_tpu.rpc.protocol import Methods, Request
+
+    def fetch_received():
+        total = 0.0
+        for fam in obs_metrics.registry().snapshot()["families"]:
+            if fam["name"] == "gol_wire_bytes_total":
+                for s in fam["series"]:
+                    if s.get("labels") == [Methods.STRIP_FETCH, "received"]:
+                        total += s["value"]
+        return total
+
+    size, turns = 1024, 3
+    board = _r_pentomino(size, size)
+    want = _oracle_n(board, turns)
+    got, sync_bytes = {}, {}
+    for sparse in (True, False):
+        servers = [rpc_worker.serve(port=0) for _ in range(2)]
+        addrs = [f"127.0.0.1:{s.port}" for s, _ in servers]
+        backend = WorkersBackend(
+            addrs, wire="resident", halo_depth=1, sync_interval=0,
+            sparse_sync=sparse,
+        )
+        try:
+            b0 = fetch_received()
+            res = backend.run(Request(
+                world=board, turns=turns, threads=2,
+                image_width=size, image_height=size,
+            ))
+            sync_bytes[sparse] = fetch_received() - b0
+            got[sparse] = np.asarray(res.world)
+        finally:
+            backend.close()
+            for server, _service in servers:
+                server.stop()
+    np.testing.assert_array_equal(got[True], want)
+    np.testing.assert_array_equal(got[False], want)
+    assert sync_bytes[True] * 10 <= sync_bytes[False], (
+        f"delta sync {sync_bytes[True]:.0f} B vs full "
+        f"{sync_bytes[False]:.0f} B"
+    )
+    assert _metric("gol_sparse_frame_bytes_total") > 0
+
+
+# -- delta checkpoints -------------------------------------------------------
+
+
+def test_delta_checkpoint_roundtrip_and_refusals(tmp_path):
+    from gol_distributed_final_tpu.engine.checkpoint import (
+        CheckpointError,
+        apply_delta_checkpoint,
+        checkpoint_digest,
+        clear_delta_checkpoints,
+        delta_checkpoint_paths,
+        load_resume_checkpoint,
+        npz_path,
+        save_checkpoint,
+        save_delta_checkpoint,
+    )
+
+    base = _r_pentomino(256, 512)
+    later = _oracle_n(base, 10)
+    p = tmp_path / "ck.npz"
+    save_checkpoint(p, base, 100, CONWAY)
+    base_digest = checkpoint_digest(base, 100, CONWAY.rulestring)
+    dirty = dirty_tile_grid(base, later)
+    dpath = save_delta_checkpoint(
+        p, later, dirty, 110, CONWAY, 100, base_digest
+    )
+    assert delta_checkpoint_paths(p) == [(110, dpath)]
+    # the delta's tile payload is a fraction of the full board's bytes
+    with np.load(dpath, allow_pickle=False) as data:
+        assert data["tiles"].nbytes < later.nbytes
+
+    # round-trip through the -resume loader: full gen + newest delta
+    board, turn, rule, gen = load_resume_checkpoint(p)
+    assert turn == 110 and gen == 0
+    assert np.array_equal(board, later)
+
+    # wrong base refuses with a typed error
+    other = np.zeros((128, 128), np.uint8)
+    with pytest.raises(CheckpointError) as exc:
+        apply_delta_checkpoint(dpath, other, 100, CONWAY)
+    assert exc.value.kind == "delta-base"
+
+    # corrupted delta: flip payload bytes inside the npz -> digest
+    # refusal, and -resume falls back to the verified FULL generation
+    with np.load(dpath, allow_pickle=False) as data:
+        fields = {k: data[k] for k in data.files}
+    fields["tiles"] = np.asarray(fields["tiles"], np.uint8) ^ 255
+    np.savez_compressed(dpath.with_suffix(""), **fields)
+    with pytest.raises(CheckpointError) as exc:
+        apply_delta_checkpoint(dpath, base, 100, CONWAY)
+    assert exc.value.kind == "digest"
+    board, turn, rule, gen = load_resume_checkpoint(p)
+    assert turn == 100 and np.array_equal(board, base)
+
+    clear_delta_checkpoints(p)
+    assert delta_checkpoint_paths(p) == []
+
+
+def test_broker_auto_checkpoint_writes_deltas(tmp_path, live_metrics):
+    """End to end: a resident broker with -auto-checkpoint 0 writes a
+    full keyframe first, then dirty-tile deltas the -resume loader
+    replays onto it."""
+    from gol_distributed_final_tpu.engine.checkpoint import (
+        delta_checkpoint_paths,
+        load_resume_checkpoint,
+    )
+    from gol_distributed_final_tpu.rpc import worker as rpc_worker
+    from gol_distributed_final_tpu.rpc.broker import WorkersBackend
+    from gol_distributed_final_tpu.rpc.protocol import Request
+
+    size, turns = 128, 6
+    board = _r_pentomino(size, size)
+    ck = tmp_path / "auto.npz"
+    servers = [rpc_worker.serve(port=0) for _ in range(2)]
+    addrs = [f"127.0.0.1:{s.port}" for s, _ in servers]
+    backend = WorkersBackend(
+        addrs, wire="resident", halo_depth=1, sync_interval=1,
+        auto_checkpoint=(0.0, str(ck)),
+    )
+    try:
+        backend.run(Request(
+            world=board, turns=turns, threads=2,
+            image_width=size, image_height=size,
+        ))
+    finally:
+        backend.close()
+        for server, _service in servers:
+            server.stop()
+    deltas = delta_checkpoint_paths(ck)
+    assert deltas, "deltas must land between full keyframes"
+    board_r, turn_r, rule_r, _gen = load_resume_checkpoint(ck)
+    assert turn_r == deltas[-1][0]
+    assert np.array_equal(board_r, _oracle_n(board, turn_r))
+
+
+# -- the regress gates + the watch panel -------------------------------------
+
+
+def test_regress_gates_active_throughput_and_sync_bytes():
+    from gol_distributed_final_tpu.obs.regress import compare_case
+
+    base = {
+        "per_turn_us": 100.0, "n_lo": 100, "n_hi": 1100, "spread_s": 0.0001,
+        "cell_updates_per_s_active": 1e9,
+        "sparse_frame_bytes_per_sync": 1000.0,
+    }
+    # a 30% per-active-cell throughput drop past the noise band gates
+    worse = dict(base, cell_updates_per_s_active=0.7e9)
+    v = compare_case(base, worse)
+    assert v["verdict"] == "REGRESSED" and "active" in v["why"]
+    # sparse sync byte growth gates deterministically — even when the
+    # wall-clock fit is unusable (the c11 case shape)
+    nofit = dict(base, per_turn_us=0.0)
+    fat = dict(nofit, sparse_frame_bytes_per_sync=1500.0)
+    v = compare_case(nofit, fat)
+    assert v["verdict"] == "REGRESSED" and "sparse sync bytes" in v["why"]
+    # within threshold: no gate
+    ok = dict(
+        base,
+        cell_updates_per_s_active=0.99e9,
+        sparse_frame_bytes_per_sync=1010.0,
+    )
+    v = compare_case(base, ok)
+    assert v["verdict"] != "REGRESSED"
+
+
+def test_watch_sparsity_panel_renders(live_metrics):
+    from gol_distributed_final_tpu.obs.instruments import (
+        ACTIVE_TILES,
+        EARLY_EXIT_TOTAL,
+        SPARSE_FRAME_BYTES_TOTAL,
+        TILE_SKIPS_TOTAL,
+    )
+    from gol_distributed_final_tpu.obs.watch import render_status
+
+    ACTIVE_TILES.set(42)
+    TILE_SKIPS_TOTAL.inc(1000)
+    SPARSE_FRAME_BYTES_TOTAL.inc(2048)
+    EARLY_EXIT_TOTAL.labels("still").inc()
+    payload = {
+        "role": "broker",
+        "pid": 1,
+        "metrics_enabled": True,
+        "metrics": obs_metrics.registry().snapshot(),
+    }
+    out = render_status("t", payload)
+    assert "SPARSITY" in out
+    assert "active tiles 42" in out
+    assert "still 1" in out
+
+
+def test_sparse_lint_both_ways(tmp_path):
+    from gol_distributed_final_tpu.obs.lint import undocumented_sparse_names
+
+    assert undocumented_sparse_names() == []
+    bad = tmp_path / "README.md"
+    bad.write_text("# x\n## Sparse stepping\nonly gol_active_tiles here\n")
+    missing = undocumented_sparse_names(bad)
+    assert "gol_early_exit_total" in missing
+    assert "GOL_SPARSE" in missing
