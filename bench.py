@@ -20,6 +20,11 @@ single-chip BASELINE configs:
             device-resident batch (engine/sessions.py over the batched
             kernel family) vs 1k sequential runs; gates bit-identical
             per-universe parity and >= 10x sessions/sec
+  config 12: fused vs serial launch chains — the 128^2 floor case stepped
+            one-launch-per-turn vs K=8 turns per launch (ops/fused.py);
+            gates bit-identical boards, >= 5x per-turn on TPU, and the
+            roofline flip off launch-bound; embeds dispatches_per_turn
+            (deterministic — bench_diff gates it with no noise band)
 
 Parity gates: exact alive counts against check/alive/512x512.csv at turns
 1000 and 10000 plus the period-2 steady state; 128^2 against a numpy
@@ -481,6 +486,129 @@ def _bench_sparse_wire(extra: dict) -> int:
         "workers": 4,
         "turns": turns,
     }
+    return 0
+
+
+def _bench_fused(extra: dict) -> int:
+    """Fused vs serial launch chains (config 12): the 128² floor case —
+    BENCH_r04's launch-bound site — stepped two ways on the same device:
+
+    * ``c12_128_serial_per_turn`` — ONE kernel launch per turn (the
+      per-turn dispatch chain every pre-fused caller pays): the floor
+      this PR exists to kill, embedded with ``dispatches_per_turn=1.0``.
+    * ``c12_128_fused_k8`` — the fused ladder (ops/fused.py): K=8 turns
+      per launch, all launches inside one jitted program;
+      ``dispatches_per_turn=1/K`` (exact ladder arithmetic — launch
+      accounting is deterministic, so obs/regress.py gates it with no
+      noise band, the wire-bytes posture).
+
+    Hard gates: bit-identical boards (odd 137-turn horizon, so the pow2
+    remainder ladder is in the parity path), fused ≥ 5× serial per turn
+    on TPU (the ISSUE 15 acceptance bar; ≥ 2× elsewhere — a CPU serial
+    chain pays a smaller dispatch floor, measured ~12× here), and the
+    PR 12 roofline flip: where the serial chain classifies launch-bound,
+    the fused case must NOT (asserted on TPU, reported elsewhere —
+    fitted CPU ceilings are too coarse to pin a hard class)."""
+    import numpy as np
+
+    import jax
+
+    from gol_distributed_final_tpu.io.pgm import read_pgm
+    from gol_distributed_final_tpu.obs import perf as obs_perf
+    from gol_distributed_final_tpu.ops import bitpack
+    from gol_distributed_final_tpu.ops.fused import _ladder, fused_bit_step_n
+    from gol_distributed_final_tpu.ops.pallas_stencil import _bit_compiled
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    size, fused_k = 128, 8
+    board = read_pgm("images/128x128.pgm")
+    packed = jax.device_put(bitpack.pack(board, 0))
+    step1 = _bit_compiled(1, 0, not on_tpu)
+
+    def evolve_serial(n):
+        # the per-turn dispatch chain: n launches, serially dependent
+        state = packed
+        for _ in range(n):
+            state = step1(state)
+        return np.asarray(state)  # full sync (the c3 posture)
+
+    def evolve_fused(n):
+        return np.asarray(
+            fused_bit_step_n(packed, n, k=fused_k, interpret=not on_tpu)
+        )
+
+    if not np.array_equal(evolve_serial(137), evolve_fused(137)):
+        print(
+            "FUSED PARITY FAILURE: fused-K 128^2 diverges from the serial "
+            "per-turn chain at 137 turns", file=sys.stderr,
+        )
+        return 1
+    print("parity fused ok (137 turns, fused == serial bit-identical)",
+          file=sys.stderr)
+
+    ns_lo, ns_hi = 2_000, 22_000
+    evolve_serial(ns_lo), evolve_serial(ns_hi)  # warm both shapes
+    pt_serial, det_serial = gated(
+        evolve_serial, ns_lo, ns_hi, "c12_128_serial_per_turn"
+    )
+    nf_lo, nf_hi = 20_000, 520_000
+    evolve_fused(nf_lo), evolve_fused(nf_hi)
+    pt_fused, det_fused = gated(evolve_fused, nf_lo, nf_hi, "c12_128_fused_k8")
+
+    full, rem_ks = _ladder(nf_hi, fused_k)
+    fused_dpt = (full + len(rem_ks)) / nf_hi
+    speedup = pt_serial / pt_fused
+    floor_gate = 5.0 if on_tpu else 2.0
+    if speedup < floor_gate:
+        print(
+            f"FUSED GATE FAILURE: fused K={fused_k} is only {speedup:.1f}x "
+            f"the serial per-turn chain ({pt_fused * 1e6:.3f} vs "
+            f"{pt_serial * 1e6:.3f} us/turn) — less than the "
+            f"{floor_gate:.0f}x contract", file=sys.stderr,
+        )
+        return 1
+    print(
+        f"fused gate ok: {pt_fused * 1e6:.3f} us/turn fused vs "
+        f"{pt_serial * 1e6:.3f} serial ({speedup:.1f}x, gate "
+        f"{floor_gate:.0f}x)", file=sys.stderr,
+    )
+
+    # roofline flip (obs/perf.py): the serial chain's wall is the launch
+    # floor; the fused case must leave the launch-bound class behind
+    ceilings = obs_perf.calibrate()
+    cls_serial = obs_perf.classify_case(size, size, pt_serial, ceilings)
+    cls_fused = obs_perf.classify_case(size, size, pt_fused, ceilings)
+    print(
+        f"roofline fused pair: serial {cls_serial['bound_class']} -> "
+        f"fused {cls_fused['bound_class']} (vs {ceilings.device_kind} "
+        "ceilings)", file=sys.stderr,
+    )
+    if (
+        on_tpu
+        and cls_serial["bound_class"] == "launch-bound"
+        and cls_fused["bound_class"] == "launch-bound"
+    ):
+        print(
+            "FUSED ROOFLINE GATE FAILURE: the fused 128^2 case still "
+            "classifies launch-bound — K turns per launch did not move "
+            "the site off the dispatch floor", file=sys.stderr,
+        )
+        return 1
+
+    extra["c12_128_serial_per_turn"] = dict(
+        det_serial,
+        cell_updates_per_s=round(size * size / pt_serial),
+        dispatches_per_turn=1.0,
+        **cls_serial,
+    )
+    extra["c12_128_fused_k8"] = dict(
+        det_fused,
+        cell_updates_per_s=round(size * size / pt_fused),
+        dispatches_per_turn=round(fused_dpt, 5),
+        fused_k=fused_k,
+        speedup_vs_serial=round(speedup, 1),
+        **cls_fused,
+    )
     return 0
 
 
@@ -1095,6 +1223,11 @@ def _bench_body() -> int:
 
     # ---- config 11: dirty-tile delta syncs — sparse resident wire --------
     rc = _bench_sparse_wire(extra)
+    if rc:
+        return rc
+
+    # ---- config 12: fused vs serial launch chains — the 128^2 floor ------
+    rc = _bench_fused(extra)
     if rc:
         return rc
 

@@ -40,9 +40,20 @@ ENTRY_POINTS: Dict[str, int] = {
     "bit_step_n": 1,
     "step_n_batch": 1,
     "bit_step_n_batch": 1,
+    # the fused K-turns-per-launch family (ops/fused.py): the turn count
+    # AND the K argument are both static compile keys — K is quantised
+    # inside the entry (quantise_k), so a caller-side raw K passes
+    # through the same quantiser-chain rule as a chunk size
+    "fused_bit_step_n": 1,
+    "fused_step_n": 1,
+    "fused_bit_step_n_batch": 1,
+    "fused_strip_steps": 1,
+    "step_n_counted": 1,
+    "step_n_counts": 1,
 }
-#: keyword spellings of the same argument
-TURN_KWARGS = ("n", "turns")
+#: keyword spellings of the same argument (``k`` is the fused family's
+#: static turns-per-launch — same unbounded-cache hazard as ``n``)
+TURN_KWARGS = ("n", "turns", "k")
 
 #: substrings that mark a call/attribute as a quantiser: a derivation
 #: that passes through one lands on a bounded key set

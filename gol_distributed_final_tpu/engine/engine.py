@@ -182,6 +182,12 @@ class Engine:
         # Retrieve keeps serving the final snapshot (the cWorld analogue)
         self._state = None
         self._plane = None
+        # the committed state's on-device alive fold (ops/fused.py
+        # step_n_counted protocol): a device vector whose int64 host sum
+        # is the alive count — set by counted chunk commits, cleared by
+        # anything else, so the count-only Retrieve ticker never pays a
+        # reduction dispatch while a fused plane is driving
+        self._state_counts = None
         self._world_host: np.ndarray | None = None  # last synced host copy
         self._host_dirty = False
         self._turn = 0
@@ -282,6 +288,7 @@ class Engine:
             # per-run plane selection happens only after the already-running
             # check, so a rejected concurrent run can't clobber the active
             # run's representation
+            self._state_counts = None  # a fresh run has no folded count yet
             if initial_state is not None:
                 self._plane = plane
                 self._state = initial_state
@@ -396,6 +403,7 @@ class Engine:
                     _tracing.start_span(_tracing.SPAN_ENGINE_CHUNK, turns=n)
                     if _tracing.enabled() else None
                 )
+                chunk_counts = None
                 with _tracing.annotate("engine.chunk"):
                     if early:
                         # O(1): a still life is itself, a period-2 cycle
@@ -403,6 +411,22 @@ class Engine:
                         # (gol_early_exit_total was metered by the plane
                         # at DETECTION; this jump just cashes it in)
                         new_state = active_plane.fast_forward(state, n)
+                    elif not emit_flips and hasattr(
+                        active_plane, "step_n_counted"
+                    ):
+                        # the fused device-resident driver (ops/fused.py
+                        # protocol, ops/plane.py): the chunk's turns AND
+                        # its alive reduction in ONE dispatch — the host
+                        # touches the board only at these boundaries,
+                        # and the committed fold serves the count-only
+                        # Retrieve ticker below with no dispatch.
+                        # gol: allow(jit-cache): chunk doubles by powers
+                        # of two; the min() only clips the FINAL
+                        # remainder, so a run compiles at most
+                        # log2(turns)+2 distinct n values
+                        new_state, chunk_counts = active_plane.step_n_counted(
+                            state, n
+                        )
                     else:
                         # gol: allow(jit-cache): chunk doubles by powers
                         # of two; the min() only clips the FINAL
@@ -498,6 +522,9 @@ class Engine:
                 with self._lock:
                     prev_host = self._world_host if emit_flips else None
                     self._state = new_state
+                    # None unless this chunk was a fused counted dispatch
+                    # — a stale fold must never outlive its state
+                    self._state_counts = chunk_counts
                     self._host_dirty = True
                     self._turn += n
                     turn_now = self._turn
@@ -752,9 +779,21 @@ class Engine:
                 world = self._world_host
             else:
                 state, active_plane = self._state, self._plane
+                counts = self._state_counts
                 world = None
         if not include_world:
-            count = active_plane.alive_count(state) if state is not None else 0
+            if state is None:
+                count = 0
+            elif counts is not None:
+                # the fused driver already folded this state's count on
+                # device inside the chunk dispatch (step_n_counted) —
+                # the 2-second ticker costs a host sum, not a reduction
+                # dispatch
+                from ..ops.fused import fold_counts
+
+                count = fold_counts(counts)
+            else:
+                count = active_plane.alive_count(state)
             return Snapshot(world, turn, count)
         if world is None:
             world = np.zeros((0, 0), np.uint8)

@@ -266,12 +266,19 @@ class SessionTable:
             # their budgets exactly.
             k = 1 << (k.bit_length() - 1)
         t_chunk = time.monotonic()
-        if k > 0:
-            state = self._plane.step_n(state, k)
-        # ONE batched reduction; every per-session count demuxes from it
-        counts = self._plane.alive_counts(state)
-        dt_chunk = time.monotonic() - t_chunk  # the reduction forces the
-        # dispatch, so this is real time, not enqueue time
+        if k > 0 and hasattr(self._plane, "step_n_counts"):
+            # the fused-K x batched chunk program (ops/fused.py via
+            # ops/batched.py): the chunk's turns AND the per-universe
+            # alive reduction in ONE dispatch — the serving hot path
+            # pays one launch chain per chunk instead of step + count
+            state, counts = self._plane.step_n_counts(state, k)
+        else:
+            if k > 0:
+                state = self._plane.step_n(state, k)
+            # ONE batched reduction; every per-session count demuxes from it
+            counts = self._plane.alive_counts(state)
+        dt_chunk = time.monotonic() - t_chunk  # the count transfer forces
+        # the dispatch, so this is real time, not enqueue time
         if attribution:
             # dispatch-wall decomposition (obs/perf.py): join/encode of
             # pending universes is host_prep, the forced batched dispatch

@@ -494,6 +494,31 @@ EARLY_EXIT_TOTAL = _R.counter(
     labelnames=("kind",),
 )
 
+# -- fused K-turns-per-launch stepping (ops/fused.py, rpc/worker.py) ---------
+
+FUSED_LAUNCHES_TOTAL = _R.counter(
+    "gol_fused_launches_total",
+    "Device kernel launches issued by the fused K-turns-per-launch tier "
+    "(ops/fused.py: whole-board/tiled/batched ladders, fused step+count "
+    "programs, the worker's fused strip batch). The denominator of the "
+    "launch-amortisation story: turns advanced / launches issued is the "
+    "effective K.",
+)
+FUSED_TURNS_PER_LAUNCH = _R.histogram(
+    "gol_fused_turns_per_launch",
+    "Turns advanced per fused device launch (the K distribution): full-K "
+    "ladder launches observe K, pow2 remainder launches their size, and "
+    "one-dispatch step+count programs the whole chunk. A collapse toward "
+    "1 means the fusion is being bypassed — the launch floor is back.",
+)
+STRIP_ROWS_SKIPPED_TOTAL = _R.counter(
+    "gol_strip_rows_skipped_total",
+    "Row-steps the resident worker's dead-band skip did NOT compute "
+    "(rows outside the live frontier's K-deep dependency cone, summed "
+    "over the batch's steps — rpc/worker.strip_step_batch): the work the "
+    "frontier bound saved vs stepping the full strip.",
+)
+
 # -- lock sanitizer (utils/locksan.py) ---------------------------------------
 
 LOCKSAN_VIOLATIONS_TOTAL = _R.counter(

@@ -93,6 +93,10 @@ _REQUIRED_SECTIONS = (
     # density crossover, the delta-frame format, the early-exit
     # contract, and the knobs
     "## Sparse stepping",
+    # the fused K-turns-per-launch contract (ops/fused.py + the engine
+    # chunk driver + the worker strip paths): the K/VMEM trade-off
+    # table, the routing knobs, and the launch-amortisation metric pair
+    "## Fused stepping",
 )
 
 # the wire data-plane metric families (rpc/protocol.py frames + the
@@ -307,6 +311,31 @@ def undocumented_sparse_names(readme_path=None) -> List[str]:
     return sorted(n for n in _SPARSE_DOC_NAMES if n not in section)
 
 
+# the fused-stepping contract names (ops/fused.py, the engine's counted
+# chunk driver, the worker's skip/fused strip paths): the launch-
+# amortisation metric pair, the row-skip meter, and the routing knobs —
+# these must be documented in the README's "Fused stepping" section
+# specifically, the operator contract bench's fused-vs-serial pair and
+# the roofline's fused sites are read against
+_FUSED_DOC_NAMES = (
+    "gol_fused_launches_total",
+    "gol_fused_turns_per_launch",
+    "gol_strip_rows_skipped_total",
+    "GOL_FUSED",
+    "GOL_WORKER_FUSED",
+    "-halo-depth",
+)
+
+
+def undocumented_fused_names(readme_path=None) -> List[str]:
+    """Fused-stepping metric/knob names missing from the README's
+    "Fused stepping" section specifically (the wire/device-table
+    posture: a name mentioned elsewhere in the file does not count as
+    documented here)."""
+    section = _readme_section(readme_path, "## Fused stepping")
+    return sorted(n for n in _FUSED_DOC_NAMES if n not in section)
+
+
 def missing_readme_sections(readme_path=None) -> List[str]:
     """Required operator-facing README sections that are absent."""
     if readme_path is None:
@@ -413,6 +442,14 @@ CHECKS = (
         "sparse-stepping metric/knob names missing from README.md's "
         "Sparse stepping section:",
         "sparse lint ok: every sparse metric and knob is in the Sparse "
+        "stepping section",
+    ),
+    (
+        "lint-fused-metrics",
+        undocumented_fused_names,
+        "fused-stepping metric/knob names missing from README.md's "
+        "Fused stepping section:",
+        "fused lint ok: every fused metric and knob is in the Fused "
         "stepping section",
     ),
     (

@@ -192,6 +192,7 @@ def compare_case(
         out["verdict"] = "incomparable"
         out = _apply_roofline_gate(old, new, out, threshold, 0.0)
         out = _apply_sparse_gates(old, new, out, threshold, 0.0)
+        out = _apply_fused_gate(old, new, out, threshold)
         return _apply_wire_bytes_gate(old, new, out, threshold)
     delta = new_us - old_us
     rel = delta / old_us
@@ -214,6 +215,7 @@ def compare_case(
         out["verdict"] = "improved" if -rel > threshold else "faster"
     out = _apply_roofline_gate(old, new, out, threshold, noise_us / old_us)
     out = _apply_sparse_gates(old, new, out, threshold, noise_us / old_us)
+    out = _apply_fused_gate(old, new, out, threshold)
     return _apply_wire_bytes_gate(old, new, out, threshold)
 
 
@@ -287,6 +289,28 @@ def _apply_sparse_gates(
         if bytes_rel > threshold:
             out["verdict"] = "REGRESSED"
             out["why"] = "sparse sync bytes grew past threshold"
+    return out
+
+
+def _apply_fused_gate(
+    old: dict, new: dict, out: dict, threshold: float
+) -> dict:
+    """The launch-floor gate (ISSUE 15 satellite): the fused bench pair
+    embeds ``dispatches_per_turn`` (device launches per turn — 1.0 for
+    the serial chain, 1/K fused). Launch accounting is DETERMINISTIC
+    like byte accounting — no noise band — so growth past the threshold
+    gates even when the wall-clock verdict is clean or unusable: a
+    routing regression that quietly un-fuses the ladder fails bench_diff
+    here, not in a later wall-clock drift."""
+    old_d, new_d = old.get("dispatches_per_turn"), new.get("dispatches_per_turn")
+    if old_d and new_d:
+        rel = (new_d - old_d) / old_d
+        out["old_dispatches_per_turn"] = old_d
+        out["new_dispatches_per_turn"] = new_d
+        out["dispatches_delta_pct"] = 100.0 * rel
+        if rel > threshold:
+            out["verdict"] = "REGRESSED"
+            out["why"] = "dispatches per turn grew past threshold"
     return out
 
 

@@ -82,10 +82,32 @@ def auto_plane(rule, shape: tuple[int, int]):
             _note_selection("sparse_bitplane")
             plane = SparseBitPlane(rule)
         else:
-            from .plane import BitPlane
+            from .bitpack import packed_shape
+            from .fused import FusedBitPlane, fused_enabled
+            from .pallas_stencil import fits_vmem
 
-            _note_selection("bitplane")
-            plane = BitPlane(rule, word_axis)
+            if fused_enabled() and fits_vmem(
+                packed_shape(*shape, word_axis), itemsize=4
+            ):
+                # the fused K-turns-per-launch tier (ops/fused.py) for
+                # VMEM-FIT bitboards — the launch-bound class: the same
+                # BitPlane step routing plus the fused step+count
+                # protocol the engine's chunk driver consumes — its own
+                # selection label AND its own kernel sites
+                # (pallas.fused_*) so the roofline table attributes
+                # fused dispatches separately from pallas.vmem_bit
+                # (GOL_FUSED=off restores the classic tier). Boards past
+                # the gate keep the classic tier: their chunk walls are
+                # compute/memory-bound, and the counted driver's
+                # per-chunk fold would be a full-board popcount inserted
+                # into the pipelined dispatch chain for nothing.
+                _note_selection("fused_bitplane")
+                plane = FusedBitPlane(rule, word_axis)
+            else:
+                from .plane import BitPlane
+
+                _note_selection("bitplane")
+                plane = BitPlane(rule, word_axis)
     _PLANE_CACHE[key] = plane
     return plane
 

@@ -96,6 +96,24 @@ class BatchBytePlane(_BatchPlane):
             survive_mask=self.rule.survive_mask,
         )
 
+    def step_n_counts(self, state, n: int):
+        """The fused chunk program (ops/fused.py): n turns for every
+        universe AND the per-universe alive reduction in ONE dispatch —
+        the session table's demux count stops paying its own launch.
+        Returns ``(state, np.int64[B])``; the host transfer forces the
+        dispatch (the advance loop's timing contract)."""
+        from .fused import _fused_byte_batch_counted_compiled, _meter_single
+
+        n = int(n)
+        if n <= 0:
+            return state, self.alive_counts(state)
+        fn = _fused_byte_batch_counted_compiled(
+            n, self.rule.birth_mask, self.rule.survive_mask
+        )
+        out, counts = fn(state)
+        _meter_single(n)
+        return out, np.asarray(counts).astype(np.int64)
+
     def decode(self, state) -> np.ndarray:
         return np.asarray(state)
 
@@ -168,6 +186,50 @@ class BatchBitPlane(_BatchPlane):
                 fallback,
             )
         return fallback()
+
+    def step_n_counts(self, state, n: int):
+        """The fused-K × batched chunk program (ops/fused.py): n turns
+        for every universe (the batch-grid pallas kernel under the
+        per-universe VMEM gate, vmapped XLA elsewhere) AND the batched
+        popcount reduction in ONE dispatch — the sessions serving hot
+        path pays one launch chain per chunk instead of step + count.
+        Returns ``(state, np.int64[B])``; the host fold forces the
+        dispatch (the advance loop's timing contract)."""
+        from . import fused as _fused
+        from . import pallas_stencil
+        from .plane import run_vmem_gated
+
+        n = int(n)
+        if n <= 0:
+            return state, self.alive_counts(state)
+        birth, survive = self.rule.birth_mask, self.rule.survive_mask
+        shape = tuple(state.shape)
+
+        def fold(out_pc):
+            out, pc = out_pc
+            pc = np.asarray(pc)
+            return out, np.sum(
+                pc.reshape(pc.shape[0], -1), axis=1, dtype=np.int64
+            )
+
+        def xla_call():
+            return _fused._fused_batch_counted_compiled(
+                n, self.word_axis, self.interpret, birth, survive, False
+            )(state)
+
+        _fused._meter_single(n)
+        if not self.interpret and pallas_stencil.fits_vmem(
+            shape[1:], itemsize=4
+        ):
+            return fold(run_vmem_gated(
+                _BATCH_VMEM_OK,
+                shape,
+                lambda: _fused._fused_batch_counted_compiled(
+                    n, self.word_axis, self.interpret, birth, survive, True
+                )(state),
+                xla_call,
+            ))
+        return fold(xla_call())
 
     def decode(self, state) -> np.ndarray:
         from .bitpack import unpack_device_batch

@@ -1,0 +1,697 @@
+"""Fused K-turns-per-launch kernels — killing the per-turn dispatch floor.
+
+BENCH_r04 pins the small/hot board case (128²) as LAUNCH-BOUND (~0.10
+µs/turn whole-board, but ~5-30 µs/turn the moment a caller issues one
+kernel launch per turn), and obs/perf.py now proves it per site: for
+every board that fits on-chip, dispatch — not FLOPs — is the ceiling.
+This module advances **K turns inside one launch** so the launch floor is
+paid once per K turns instead of once per turn, for every tier:
+
+* **whole-board VMEM** (byte AND packed-bitboard): the K-turn kernel body
+  runs K steps in-register (torus wrap is the in-kernel rotate — no halo
+  needed), and ``n`` turns decompose into a ``lax.fori_loop`` of full-K
+  launches plus a power-of-two remainder ladder, ALL inside one jitted
+  program — the host dispatches once per ``step_n`` call, the device
+  launches once per K turns.
+* **grid-tiled bitboard** (boards past the whole-board VMEM gate): each
+  grid program loads its tile plus the SAME 8-word-row halo strips the
+  single-turn kernel reads (ops/pallas_tiled.py), then steps K times
+  in-register; every step contaminates one more halo row inward — the
+  shrinking dependency cone ``_recompute_rows`` uses on the broker — so
+  up to ``_SUBLANE`` = 8 turns run per launch on one halo read before the
+  garbage reaches the interior the write keeps. K-deep halos cost ZERO
+  extra VMEM here: the 8-row strips Mosaic alignment already forces ARE
+  the K ≤ 8 cone budget.
+* **grid-tiled byte**: same shape with 32-row strips (the uint8 sublane
+  tile), cone budget K ≤ 32 (clamped to the same pow2 ladder).
+* **batched grid** (the sessions serving hot path): one grid program per
+  universe × K turns per launch — fused-K × batched, so PR 7's batch
+  amortisation and this PR's launch fusion compound.
+* **fused step+count programs**: a chunk's evolution AND its alive
+  reduction in ONE dispatch (``*_counted`` / ``*_counts``) — the
+  engine's chunk driver and the session table's demux reduction stop
+  paying a second dispatch per chunk, and the 2-second ticker serves the
+  folded count with no dispatch at all.
+* **``fused_strip_steps``**: the resident worker's StripStep batch as one
+  jitted shrinking-form program (rpc/worker.py routes big strips here) —
+  PR 5's K-turn wire batching and the fused kernel compound: one RPC, one
+  dispatch, K turns.
+
+K is ALWAYS quantised to a power of two (``quantise_k``) before it
+reaches a compile cache, mirroring the session batcher: chunk churn in a
+long-lived broker lands on the bounded key set {1, 2, 4, 8}, never on a
+fresh Mosaic compile per distinct chunk size.
+
+Metering: ``gol_fused_launches_total`` counts device launches issued by
+this tier and ``gol_fused_turns_per_launch`` their K distribution — the
+pair the README "Fused stepping" section documents and obs/lint.py
+enforces. Kernel sites (``pallas.fused_bit`` / ``pallas.fused_byte`` /
+``pallas.fused_tiled`` / ``pallas.fused_bit_batch`` / ``fused.*``) are
+attributed separately from the classic tiers so the PR 12 roofline table
+shows the fused sites' bound-class flip on their own rows.
+
+Every path is bit-identical to the serial per-turn computation — fusing
+changes WHEN launches happen, never the arithmetic (tests/test_fused.py
+pins parity across K, odd remainders, geometries, rules, and the batch).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..obs import device as _device
+from ..obs import instruments as _ins
+from . import pallas_stencil
+from .bitpack import bit_step_n, bit_step_n_batch
+from .pallas_tiled import _EXT_BYTES_TARGET, _SUBLANE, can_tile, tiled_pallas_call
+from .plane import BitPlane, run_vmem_gated
+from .stencil import CONWAY_BIRTH_MASK, CONWAY_SURVIVE_MASK
+
+#: the fused-K ceiling: the tiled kernels' 8-word-row halo strips are the
+#: dependency-cone budget (one row consumed per fused step), and the
+#: whole-board ladder keeps the same bound so ONE quantiser serves every
+#: tier. Power of two by construction.
+FUSED_MAX_K = _SUBLANE  # 8
+FUSED_K_DEFAULT = 8
+
+#: byte-tiled geometry: uint8 Mosaic tiles are (32, 128), so halo strips
+#: are 32 cell rows deep and blocks align to 32 rows / full width
+_BYTE_SUBLANE = 32
+_BYTE_LANE = 128
+
+#: shape -> whether the fused whole-board VMEM kernels actually
+#: compiled+ran (the ops/plane.py _VMEM_KERNEL_OK posture: fits_vmem is
+#: an estimate, so the FIRST Mosaic failure for a shape routes it to the
+#: tiled/XLA fallback and is cached, never re-attempted) — one cache per
+#: kernel family
+_FUSED_VMEM_OK: dict = {}
+_FUSED_BYTE_VMEM_OK: dict = {}
+_FUSED_BATCH_VMEM_OK: dict = {}
+
+
+def fused_enabled() -> bool:
+    """The ``GOL_FUSED`` routing knob (ops/auto.py): ``on``/``auto``
+    (default) route VMEM-fit bitboards to the fused plane, ``off``
+    keeps the classic tiers."""
+    return os.environ.get("GOL_FUSED", "auto").lower() != "off"
+
+
+def quantise_k(k: int) -> int:
+    """The fused-K quantiser: the largest power of two <= min(k,
+    FUSED_MAX_K), >= 1 — the SAME pow2 posture as the session batcher's
+    chunk quantisation, so chunk churn never compiles a fresh kernel
+    (compile keys land on {1, 2, 4, 8})."""
+    k = max(1, min(int(k), FUSED_MAX_K))
+    return 1 << (k.bit_length() - 1)
+
+
+def _ladder(n: int, k: int) -> tuple[int, tuple[int, ...]]:
+    """``n`` turns as ``full`` launches of K plus a pow2 remainder ladder
+    (one launch per set bit of ``n % k``) — launch sizes drawn from the
+    bounded set {k, k/2, ..., 1}, so a long-lived process compiles at
+    most log2(k)+1 kernel bodies per tier."""
+    full, rem = divmod(n, k)
+    rem_ks = tuple(1 << b for b in range(k.bit_length()) if rem >> b & 1)
+    return full, rem_ks
+
+
+def _meter_ladder(n: int, k: int) -> None:
+    full, rem_ks = _ladder(n, k)
+    _ins.FUSED_LAUNCHES_TOTAL.inc(full + len(rem_ks))
+    if full:
+        _ins.FUSED_TURNS_PER_LAUNCH.observe_n(float(k), full)
+    for r in rem_ks:
+        _ins.FUSED_TURNS_PER_LAUNCH.observe(float(r))
+
+
+def _meter_single(n: int) -> None:
+    """One launch covering all ``n`` turns (the fused step+count programs
+    and the XLA fallbacks — still one fused dispatch, K == n)."""
+    _ins.FUSED_LAUNCHES_TOTAL.inc()
+    _ins.FUSED_TURNS_PER_LAUNCH.observe(float(n))
+
+
+def _resolve(rule, birth_mask, survive_mask) -> tuple[int, int]:
+    if rule is not None:
+        return rule.birth_mask, rule.survive_mask
+    return (
+        CONWAY_BIRTH_MASK if birth_mask is None else birth_mask,
+        CONWAY_SURVIVE_MASK if survive_mask is None else survive_mask,
+    )
+
+
+def _jit_ladder(launch_k, rem_launches, full: int):
+    """ONE jitted program: ``full`` K-turn launches under a device-side
+    ``lax.fori_loop`` + the remainder launches — the host dispatches
+    once regardless of n."""
+
+    @jax.jit
+    def run(state):
+        out = state
+        if full:
+            out = lax.fori_loop(0, full, lambda _, s: launch_k(s), out)
+        for launch in rem_launches:
+            out = launch(out)
+        return out
+
+    return run
+
+
+# -- packed-bitboard tier -----------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _fused_vmem_bit_compiled(
+    n: int, k: int, word_axis: int, interpret: bool,
+    birth_mask: int, survive_mask: int,
+):
+    # the ladder's stage body: the K-turn VMEM pallas launch on real
+    # TPU; under the interpreter the SAME K-turn evolution as a plain
+    # bit_step chain — the BatchBitPlane posture (interpret-mode pallas
+    # pays per-launch emulation overhead that would bury the very floor
+    # this tier removes; off-TPU there is no launch floor, only the
+    # ladder structure matters and parity is bit-exact either way)
+    def stage(turns: int):
+        if not interpret:
+            return pallas_stencil.bit_pallas_call(
+                turns, word_axis, interpret, birth_mask, survive_mask
+            )
+        # positional statics: jit(static_argnums=...) rejects keywords
+        return lambda p, t=turns: bit_step_n(
+            p, t, word_axis, birth_mask, survive_mask
+        )
+
+    full, rem_ks = _ladder(n, k)
+    return _device.instrument_jit(
+        "pallas.fused_bit",
+        _jit_ladder(stage(k), [stage(r) for r in rem_ks], full),
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _fused_tiled_compiled(
+    n: int, k: int, shape: tuple[int, int], interpret: bool,
+    birth_mask: int, survive_mask: int, word_axis: int = 0,
+    block_rows: int | None = None, block_cols: int | None = None,
+):
+    full, rem_ks = _ladder(n, k)
+    launch_k = tiled_pallas_call(
+        k, shape, interpret, birth_mask, survive_mask,
+        block_rows, block_cols, word_axis,
+    )
+    rems = [
+        tiled_pallas_call(
+            r, shape, interpret, birth_mask, survive_mask,
+            block_rows, block_cols, word_axis,
+        )
+        for r in rem_ks
+    ]
+    return _device.instrument_jit(
+        "pallas.fused_tiled", _jit_ladder(launch_k, rems, full)
+    )
+
+
+def fused_bit_step_n(
+    packed,
+    n: int,
+    *,
+    k: Optional[int] = None,
+    word_axis: int = 0,
+    rule=None,
+    birth_mask: Optional[int] = None,
+    survive_mask: Optional[int] = None,
+    interpret: Optional[bool] = None,
+    block_rows: int | None = None,
+    block_cols: int | None = None,
+):
+    """``n`` turns on an int32 bitboard, K turns per device launch, one
+    host dispatch. Routes by the per-tile VMEM gate: the whole board as
+    the tile when it fits (halo = the in-kernel torus rotate), the
+    grid-tiled fused kernel (8-row halo strips, shrinking cone) when the
+    packed shape tiles, else the XLA bitboard step (no launch floor to
+    fuse — one dispatch either way). Bit-identical to ``bit_step_n``."""
+    n = int(n)
+    if n <= 0:
+        return packed
+    birth, survive = _resolve(rule, birth_mask, survive_mask)
+    if interpret is None:
+        interpret = pallas_stencil.default_interpret()
+    kq = quantise_k(FUSED_K_DEFAULT if k is None else k)
+    shape = tuple(packed.shape)
+
+    def tiled_or_xla():
+        if can_tile(shape):
+            fn = _fused_tiled_compiled(
+                n, kq, shape, interpret, birth, survive, word_axis,
+                block_rows, block_cols,
+            )
+            _meter_ladder(n, kq)
+            return fn(packed)
+        _meter_single(n)
+        return bit_step_n(packed, n, word_axis, birth, survive)
+
+    if pallas_stencil.fits_vmem(shape, itemsize=4) and block_rows is None \
+            and block_cols is None:
+        def kernel_call():
+            out = _fused_vmem_bit_compiled(
+                n, kq, word_axis, interpret, birth, survive
+            )(packed)
+            _meter_ladder(n, kq)
+            return out
+
+        return run_vmem_gated(_FUSED_VMEM_OK, shape, kernel_call, tiled_or_xla)
+    return tiled_or_xla()
+
+
+# -- byte-stencil tier --------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _fused_vmem_byte_compiled(
+    n: int, k: int, birth_mask: int, survive_mask: int, interpret: bool
+):
+    from .stencil import step_n
+
+    # interpret-mode stages route through the XLA roll stencil (the same
+    # posture as the bitboard ladder above): bit-identical, and the
+    # emulated per-launch overhead never lands in the ladder
+    def stage(turns: int):
+        if not interpret:
+            return pallas_stencil.byte_pallas_call(
+                turns, birth_mask, survive_mask, interpret
+            )
+        return lambda b, t=turns: step_n(
+            b, t, birth_mask=birth_mask, survive_mask=survive_mask
+        )
+
+    full, rem_ks = _ladder(n, k)
+    return _device.instrument_jit(
+        "pallas.fused_byte",
+        _jit_ladder(stage(k), [stage(r) for r in rem_ks], full),
+    )
+
+
+def can_tile_byte(shape: tuple[int, int]) -> bool:
+    """Byte boards the fused byte-tile kernel serves: 32-row-aligned
+    blocks (the uint8 Mosaic sublane tile) with more than one block,
+    128-lane-aligned full width, and a (32+64)-row ext within the VMEM
+    working-set budget (carried int32 in-kernel)."""
+    h, w = shape
+    return (
+        h % _BYTE_SUBLANE == 0
+        and h // _BYTE_SUBLANE >= 2
+        and w % _BYTE_LANE == 0
+        and (_BYTE_SUBLANE + 2 * _BYTE_SUBLANE) * w * 4 <= _EXT_BYTES_TARGET
+    )
+
+
+def _byte_tiled_plan(h: int, w: int) -> int:
+    """Block rows for the fused byte-tile kernel: the largest 32-aligned
+    divisor of h whose int32 ext fits the VMEM ext budget."""
+    best = _BYTE_SUBLANE
+    for pb in range(_BYTE_SUBLANE, h + 1, _BYTE_SUBLANE):
+        if h % pb == 0 and (pb + 2 * _BYTE_SUBLANE) * w * 4 <= _EXT_BYTES_TARGET:
+            best = pb
+    return best
+
+
+def _fused_byte_tiled_kernel(
+    top_ref, body_ref, bot_ref, out_ref, *, turns, birth_mask, survive_mask,
+    interpret,
+):
+    # the byte mirror of _tiled_kernel_rows: 32-row halo strips (uint8
+    # tile alignment), full-width blocks (column torus = the lane
+    # rotate), K steps on the int32 ext — one CELL row of contamination
+    # per step from each edge, discarded by the interior write
+    ext = jnp.concatenate(
+        [top_ref[:], body_ref[:], bot_ref[:]], axis=0
+    ).astype(jnp.int32)
+    one_turn = pallas_stencil.byte_turn_fn(birth_mask, survive_mask, interpret)
+    for _ in range(turns):
+        ext = one_turn(ext)
+    out_ref[:] = ext[_BYTE_SUBLANE:-_BYTE_SUBLANE, :].astype(jnp.uint8)
+
+
+def byte_tiled_pallas_call(
+    turns: int, shape: tuple[int, int], birth_mask: int, survive_mask: int,
+    interpret: bool,
+):
+    """The RAW fused byte-tile launch: ``turns`` turns per grid program
+    over (pb, W) uint8 blocks with 32-row halo strips."""
+    from jax.experimental import pallas as pl
+
+    if not 1 <= turns <= _BYTE_SUBLANE:
+        raise ValueError(
+            f"byte tiles support 1..{_BYTE_SUBLANE} fused turns, got {turns}"
+        )
+    h, w = shape
+    pb = _byte_tiled_plan(h, w)
+    gr = h // pb
+    rsub = pb // _BYTE_SUBLANE  # 32-row tiles per block
+
+    def up(i):
+        return ((i - 1) % gr) * rsub + rsub - 1
+
+    def down(i):
+        return ((i + 1) % gr) * rsub
+
+    kernel = functools.partial(
+        _fused_byte_tiled_kernel,
+        turns=turns,
+        birth_mask=birth_mask,
+        survive_mask=survive_mask,
+        interpret=interpret,
+    )
+    one = pl.pallas_call(
+        kernel,
+        grid=(gr,),
+        in_specs=[
+            pl.BlockSpec((_BYTE_SUBLANE, w), lambda i: (up(i), 0)),
+            pl.BlockSpec((pb, w), lambda i: (i, 0)),
+            pl.BlockSpec((_BYTE_SUBLANE, w), lambda i: (down(i), 0)),
+        ],
+        out_specs=pl.BlockSpec((pb, w), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(shape, jnp.uint8),
+        interpret=interpret,
+    )
+    return lambda board: one(board, board, board)
+
+
+@functools.lru_cache(maxsize=None)
+def _fused_byte_tiled_compiled(
+    n: int, k: int, shape: tuple[int, int], birth_mask: int,
+    survive_mask: int, interpret: bool,
+):
+    full, rem_ks = _ladder(n, k)
+    launch_k = byte_tiled_pallas_call(k, shape, birth_mask, survive_mask, interpret)
+    rems = [
+        byte_tiled_pallas_call(r, shape, birth_mask, survive_mask, interpret)
+        for r in rem_ks
+    ]
+    return _device.instrument_jit(
+        "pallas.fused_byte", _jit_ladder(launch_k, rems, full)
+    )
+
+
+def fused_step_n(
+    board,
+    n: int,
+    *,
+    k: Optional[int] = None,
+    rule=None,
+    birth_mask: Optional[int] = None,
+    survive_mask: Optional[int] = None,
+    interpret: Optional[bool] = None,
+):
+    """The byte-stencil tier's fused form: ``n`` turns on a uint8 {0,255}
+    board, K turns per launch — the whole board as the VMEM tile when it
+    fits, 32-row-strip byte tiles when the geometry aligns, else the
+    roll stencil (already one dispatch for all n). Engine-compatible
+    ``(board, n) -> board``; bit-identical to the serial stencil."""
+    n = int(n)
+    board = jnp.asarray(board)
+    if n <= 0:
+        return board
+    birth, survive = _resolve(rule, birth_mask, survive_mask)
+    if interpret is None:
+        interpret = pallas_stencil.default_interpret()
+    kq = quantise_k(FUSED_K_DEFAULT if k is None else k)
+    shape = tuple(board.shape)
+
+    def tiled_or_roll():
+        if can_tile_byte(shape):
+            fn = _fused_byte_tiled_compiled(
+                n, kq, shape, birth, survive, interpret
+            )
+            _meter_ladder(n, kq)
+            return fn(board)
+        from .stencil import step_n
+
+        _meter_single(n)
+        return step_n(board, n, birth_mask=birth, survive_mask=survive)
+
+    if pallas_stencil.fits_vmem(shape, itemsize=4):
+        def kernel_call():
+            out = _fused_vmem_byte_compiled(n, kq, birth, survive, interpret)(
+                board
+            )
+            _meter_ladder(n, kq)
+            return out
+
+        return run_vmem_gated(
+            _FUSED_BYTE_VMEM_OK, shape, kernel_call, tiled_or_roll
+        )
+    return tiled_or_roll()
+
+
+# -- batched grid variant (fused-K x batched: the serving hot path) -----------
+
+
+@functools.lru_cache(maxsize=None)
+def _fused_batch_compiled(
+    n: int, k: int, word_axis: int, interpret: bool,
+    birth_mask: int, survive_mask: int,
+):
+    full, rem_ks = _ladder(n, k)
+    launch_k = pallas_stencil.bit_batch_pallas_call(
+        k, word_axis, interpret, birth_mask, survive_mask
+    )
+    rems = [
+        pallas_stencil.bit_batch_pallas_call(
+            r, word_axis, interpret, birth_mask, survive_mask
+        )
+        for r in rem_ks
+    ]
+    return _device.instrument_jit(
+        "pallas.fused_bit_batch", _jit_ladder(launch_k, rems, full)
+    )
+
+
+def fused_bit_step_n_batch(
+    packed,
+    n: int,
+    *,
+    k: Optional[int] = None,
+    word_axis: int = 0,
+    rule=None,
+    birth_mask: Optional[int] = None,
+    survive_mask: Optional[int] = None,
+    interpret: Optional[bool] = None,
+):
+    """The batched grid variant: ``int32[B, Hw, W]`` — one grid program
+    per universe × K turns per launch, so the launch floor is amortised
+    B×K ways (fused-K × PR 7's batch axis). Per-universe VMEM gate; the
+    vmapped XLA step serves interpret mode (a serially-traced B-grid
+    would compile B copies) and gate-exceeding universes."""
+    n = int(n)
+    if n <= 0:
+        return packed
+    birth, survive = _resolve(rule, birth_mask, survive_mask)
+    if interpret is None:
+        interpret = pallas_stencil.default_interpret()
+    kq = quantise_k(FUSED_K_DEFAULT if k is None else k)
+    shape = tuple(packed.shape)
+
+    def xla_batch():
+        _meter_single(n)
+        return bit_step_n_batch(packed, n, word_axis, birth, survive)
+
+    if not interpret and pallas_stencil.fits_vmem(shape[1:], itemsize=4):
+        def kernel_call():
+            out = _fused_batch_compiled(
+                n, kq, word_axis, interpret, birth, survive
+            )(packed)
+            _meter_ladder(n, kq)
+            return out
+
+        return run_vmem_gated(
+            _FUSED_BATCH_VMEM_OK, shape, kernel_call, xla_batch
+        )
+    return xla_batch()
+
+
+# -- fused step+count programs ------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _fused_bit_counted_compiled(
+    n: int, word_axis: int, interpret: bool, birth_mask: int, survive_mask: int
+):
+    """n turns + the row popcounts of the result in ONE dispatch: the
+    engine chunk driver's program — the alive count folds on device into
+    the same launch chain, so the ticker's count-only Retrieve costs no
+    extra dispatch (engine/engine.py caches the folded counts)."""
+    launch = pallas_stencil.bit_pallas_call(
+        n, word_axis, interpret, birth_mask, survive_mask
+    )
+
+    @jax.jit
+    def run(packed):
+        out = launch(packed)
+        return out, jnp.sum(lax.population_count(out), axis=1)
+
+    return _device.instrument_jit("pallas.fused_bit", run)
+
+
+@functools.lru_cache(maxsize=None)
+def _fused_batch_counted_compiled(
+    n: int, word_axis: int, interpret: bool, birth_mask: int,
+    survive_mask: int, use_pallas: bool,
+):
+    """The sessions chunk program: n turns for every universe AND the
+    per-universe popcount reduction in ONE dispatch — the demux count no
+    longer pays its own launch (engine/sessions.py's step_n_counts
+    path)."""
+    if use_pallas:
+        step = pallas_stencil.bit_batch_pallas_call(
+            n, word_axis, interpret, birth_mask, survive_mask
+        )
+    else:
+        def step(packed):
+            return bit_step_n_batch(
+                packed, n, word_axis, birth_mask, survive_mask
+            )
+
+    @jax.jit
+    def run(packed):
+        out = step(packed)
+        return out, jnp.sum(lax.population_count(out), axis=-1)
+
+    return _device.instrument_jit(
+        "pallas.fused_bit_batch" if use_pallas else "fused.xla_bit_batch", run
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _fused_byte_batch_counted_compiled(n: int, birth_mask: int, survive_mask: int):
+    from .stencil import step_n_batch
+
+    @jax.jit
+    def run(boards):
+        out = step_n_batch(
+            boards, n, birth_mask=birth_mask, survive_mask=survive_mask
+        )
+        return out, jnp.sum(out != 0, axis=(1, 2), dtype=jnp.int32)
+
+    return _device.instrument_jit("fused.xla_byte_batch", run)
+
+
+def fold_counts(counts) -> int:
+    """Host int64 fold of a fused count vector (the alive_count_packed
+    overflow posture: per-row int32 partials, int64 total)."""
+    return int(np.sum(np.asarray(counts), dtype=np.int64))
+
+
+class FusedBitPlane(BitPlane):
+    """The fused-tier data plane ops/auto.py routes VMEM-fit bitboards
+    to: a ``BitPlane`` (identical ``step_n`` — the whole-n single launch
+    is already optimal for a plain step) plus the fused step+count
+    protocol the engine's device-resident chunk driver consumes:
+
+        step_n_counted(state, n) -> (state, counts)
+
+    ``counts`` is a device vector whose int64 host sum (``fold_counts``)
+    is the alive count of the returned state — folded ON DEVICE in the
+    SAME dispatch as the chunk's turns, so the host touches the board
+    only at chunk boundaries and the count-only Retrieve ticker is
+    served from the cache with no dispatch at all."""
+
+    def step_n_counted(self, state, n: int):
+        n = int(n)
+        shape = tuple(state.shape)
+        birth, survive = self.rule.birth_mask, self.rule.survive_mask
+        if n > 0 and pallas_stencil.fits_vmem(shape, itemsize=4):
+            def kernel_call():
+                fn = _fused_bit_counted_compiled(
+                    n, self.word_axis, self.interpret, birth, survive
+                )
+                out = fn(state)
+                _meter_single(n)
+                return out
+
+            return run_vmem_gated(
+                _FUSED_VMEM_OK, shape, kernel_call,
+                lambda: self._counted_fallback(state, n),
+            )
+        return self._counted_fallback(state, n)
+
+    def _counted_fallback(self, state, n: int):
+        # past the VMEM gate (or a gate-failed shape): the classic step
+        # routing plus a separate popcount — same result, two dispatches
+        from .bitpack import _row_popcounts
+
+        out = self.step_n(state, n) if n > 0 else state
+        return out, _row_popcounts(out)
+
+
+# -- the resident worker's fused strip batch (rpc/worker.py) ------------------
+
+
+def _jax_strip_turn(x):
+    """One shrinking-form strip turn, the exact jnp mirror of
+    rpc/worker._strip_step: columns wrap locally, rows shrink by one per
+    side (the halo rows are consumed), values stay uint8 {0, 255} —
+    bit-identical to the numpy kernel (Conway, like the reference)."""
+    ext = jnp.concatenate([x[:, -1:], x, x[:, :1]], axis=1)
+    b = (ext != 0).astype(jnp.int32)
+    counts = (
+        b[:-2, :-2] + b[:-2, 1:-1] + b[:-2, 2:]
+        + b[1:-1, :-2] + b[1:-1, 2:]
+        + b[2:, :-2] + b[2:, 1:-1] + b[2:, 2:]
+    )
+    alive = b[1:-1, 1:-1] == 1
+    nxt = jnp.where(alive, (counts == 2) | (counts == 3), counts == 3)
+    return jnp.where(nxt, jnp.uint8(255), jnp.uint8(0))
+
+
+@functools.lru_cache(maxsize=None)
+def _strip_steps_compiled(shape: tuple[int, int], k: int, h: int, attest: bool):
+    @jax.jit
+    def run(padded):
+        cur = padded
+        counts = []
+        bands = []
+        for i in range(k):
+            cur = _jax_strip_turn(cur)
+            off = k - (i + 1)
+            counts.append(jnp.sum(cur[off : off + h] != 0, dtype=jnp.int32))
+            if attest:
+                band = 2 * off
+                bands.append((cur[:band], cur[cur.shape[0] - band :]))
+        return cur, jnp.stack(counts), bands
+
+    return _device.instrument_jit("fused.strip", run)
+
+
+def fused_strip_steps(padded, k: int, strip_rows: int, *, attest: bool = False):
+    """K turns of a resident strip from its depth-K halo block in ONE
+    dispatch — the fused kernel running under the resident workers'
+    StripStep (rpc/worker.py routes big strips here), so PR 5's K-turn
+    wire batching compounds with launch fusion: one RPC, one dispatch,
+    K turns.
+
+    ``padded`` is the (strip_rows + 2K, w) uint8 block ([top K; strip;
+    bottom K]); returns ``(strip, counts, bands)`` where ``strip`` is the
+    K-turns-later strip, ``counts[i]`` the strip's alive count after step
+    i+1 (the AliveCellsCount feed), and ``bands`` — when ``attest`` — the
+    per-step shrinking attestation band pairs, materialised so the
+    caller's digest fold is byte-identical to the numpy path's
+    (rpc/integrity.py cross-attestation survives the routing)."""
+    k = int(k)
+    fn = _strip_steps_compiled(
+        tuple(padded.shape), k, int(strip_rows), bool(attest)
+    )
+    strip, counts, bands = fn(jnp.asarray(padded))
+    _meter_single(k)
+    return (
+        np.asarray(strip),
+        [int(c) for c in np.asarray(counts)],
+        [(np.asarray(t), np.asarray(b)) for t, b in bands],
+    )

@@ -88,13 +88,16 @@ def _rot1(a, shift: int, axis: int, *, interpret: bool = False):
     )
 
 
-def _kernel(board_ref, out_ref, *, n, birth_mask, survive_mask, interpret):
-    # Mosaic (v5e) vectors support only i16/i32 arithmetic — carry the board
-    # as int32 {0, 255} across turns, touch uint8 only at the HBM boundary
+def byte_turn_fn(birth_mask: int, survive_mask: int, interpret: bool):
+    """One byte-stencil turn on an int32 {0, 255} board, torus-wrapping
+    through the rotate primitive — the shared body of the whole-board
+    byte kernel and the fused byte tiles (ops/fused.py, where the cyclic
+    rotate only contaminates the halo ring the interior slice discards)."""
+
     def rot(a, shift, axis):
         return _rot1(a, shift, axis, interpret=interpret)
 
-    def one_turn(_, b):
+    def one_turn(b):
         alive = b != 0
         ones = alive.astype(jnp.int32)
         # separable 3x3 sum: vertical (cheap sublane shifts) then horizontal
@@ -106,12 +109,27 @@ def _kernel(board_ref, out_ref, *, n, birth_mask, survive_mask, interpret):
         next_alive = jnp.where(alive, survives, born) != 0
         return jnp.where(next_alive, jnp.int32(255), jnp.int32(0))
 
-    final = lax.fori_loop(0, n, one_turn, board_ref[:].astype(jnp.int32))
+    return one_turn
+
+
+def _kernel(board_ref, out_ref, *, n, birth_mask, survive_mask, interpret):
+    # Mosaic (v5e) vectors support only i16/i32 arithmetic — carry the board
+    # as int32 {0, 255} across turns, touch uint8 only at the HBM boundary
+    one_turn = byte_turn_fn(birth_mask, survive_mask, interpret)
+    final = lax.fori_loop(
+        0, n, lambda _, b: one_turn(b), board_ref[:].astype(jnp.int32)
+    )
     out_ref[:] = final.astype(jnp.uint8)
 
 
-@functools.lru_cache(maxsize=None)
-def _compiled(n: int, birth_mask: int, survive_mask: int, interpret: bool):
+def byte_pallas_call(n: int, birth_mask: int, survive_mask: int, interpret: bool):
+    """The RAW n-turn whole-board byte launch: a traceable callable
+    ``uint8[H, W] -> uint8[H, W]`` (one ``pl.pallas_call``), shared by the
+    jitted single-launch path below and the fused K-turn ladder
+    (ops/fused.py), which composes several of these inside ONE jitted
+    program. Deliberately uninstrumented — callers wrap the COMPOSED
+    program in ``_device.instrument_jit`` so the dispatch wall lands on
+    the right site."""
     from jax.experimental import pallas as pl
 
     kernel = functools.partial(
@@ -122,8 +140,7 @@ def _compiled(n: int, birth_mask: int, survive_mask: int, interpret: bool):
         interpret=interpret,
     )
 
-    @jax.jit
-    def run(board):
+    def launch(board):
         if interpret:
             return pl.pallas_call(
                 kernel,
@@ -139,6 +156,12 @@ def _compiled(n: int, birth_mask: int, survive_mask: int, interpret: bool):
             out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         )(board)
 
+    return launch
+
+
+@functools.lru_cache(maxsize=None)
+def _compiled(n: int, birth_mask: int, survive_mask: int, interpret: bool):
+    run = jax.jit(byte_pallas_call(n, birth_mask, survive_mask, interpret))
     # compile wall + cost analysis attributed to this kernel site (obs/)
     return _device.instrument_jit("pallas.vmem_byte", run)
 
@@ -191,14 +214,18 @@ def _bit_kernel(
     out_ref[:] = out
 
 
-@functools.lru_cache(maxsize=None)
-def _bit_compiled(
+def bit_pallas_call(
     n: int,
     word_axis: int,
     interpret: bool,
     birth_mask: int | None = None,
     survive_mask: int | None = None,
 ):
+    """The RAW n-turn whole-board bitboard launch: a traceable callable
+    ``int32[Hw, W] -> int32[Hw, W]`` (one ``pl.pallas_call``), shared by
+    ``_bit_compiled`` and the fused K-turn ladder (ops/fused.py), which
+    strings several launches inside ONE jitted program. Uninstrumented on
+    purpose — the composed program owns the site attribution."""
     from jax.experimental import pallas as pl
 
     from .stencil import CONWAY_BIRTH_MASK, CONWAY_SURVIVE_MASK
@@ -212,8 +239,7 @@ def _bit_compiled(
         survive_mask=CONWAY_SURVIVE_MASK if survive_mask is None else survive_mask,
     )
 
-    @jax.jit
-    def run(packed):
+    def launch(packed):
         kwargs = {}
         if interpret:
             kwargs["interpret"] = True
@@ -228,6 +254,20 @@ def _bit_compiled(
             **kwargs,
         )(packed)
 
+    return launch
+
+
+@functools.lru_cache(maxsize=None)
+def _bit_compiled(
+    n: int,
+    word_axis: int,
+    interpret: bool,
+    birth_mask: int | None = None,
+    survive_mask: int | None = None,
+):
+    run = jax.jit(
+        bit_pallas_call(n, word_axis, interpret, birth_mask, survive_mask)
+    )
     # compile wall + cost analysis attributed to this kernel site (obs/)
     return _device.instrument_jit("pallas.vmem_bit", run)
 
@@ -255,6 +295,45 @@ def _bit_kernel_batch(
     out_ref[:] = out.reshape(out_ref.shape)
 
 
+def bit_batch_pallas_call(
+    n: int,
+    word_axis: int,
+    interpret: bool,
+    birth_mask: int | None = None,
+    survive_mask: int | None = None,
+):
+    """The RAW n-turn batched bitboard launch (one grid program per
+    universe): a traceable callable ``int32[B, Hw, W] -> [B, Hw, W]``,
+    shared by ``_bit_compiled_batch`` and the fused batched ladder /
+    fused step+count programs (ops/fused.py). Uninstrumented on purpose
+    (the composed program owns the site attribution)."""
+    from jax.experimental import pallas as pl
+
+    from .stencil import CONWAY_BIRTH_MASK, CONWAY_SURVIVE_MASK
+
+    kernel = functools.partial(
+        _bit_kernel_batch,
+        n=n,
+        word_axis=word_axis,
+        interpret=interpret,
+        birth_mask=CONWAY_BIRTH_MASK if birth_mask is None else birth_mask,
+        survive_mask=CONWAY_SURVIVE_MASK if survive_mask is None else survive_mask,
+    )
+
+    def launch(packed):
+        b, rows, width = packed.shape
+        return pl.pallas_call(
+            kernel,
+            grid=(b,),
+            in_specs=[pl.BlockSpec((1, rows, width), lambda i: (i, 0, 0))],
+            out_specs=pl.BlockSpec((1, rows, width), lambda i: (i, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct(packed.shape, packed.dtype),
+            interpret=interpret,
+        )(packed)
+
+    return launch
+
+
 @functools.lru_cache(maxsize=None)
 def _bit_compiled_batch(
     n: int,
@@ -271,31 +350,9 @@ def _bit_compiled_batch(
     single-board VMEM gate applies per universe, not per batch, so a
     thousand 128^2 boards batch into one launch that amortises the
     dispatch-latency floor (BENCH_r04) N ways."""
-    from jax.experimental import pallas as pl
-
-    from .stencil import CONWAY_BIRTH_MASK, CONWAY_SURVIVE_MASK
-
-    kernel = functools.partial(
-        _bit_kernel_batch,
-        n=n,
-        word_axis=word_axis,
-        interpret=interpret,
-        birth_mask=CONWAY_BIRTH_MASK if birth_mask is None else birth_mask,
-        survive_mask=CONWAY_SURVIVE_MASK if survive_mask is None else survive_mask,
+    run = jax.jit(
+        bit_batch_pallas_call(n, word_axis, interpret, birth_mask, survive_mask)
     )
-
-    @jax.jit
-    def run(packed):
-        b, rows, width = packed.shape
-        return pl.pallas_call(
-            kernel,
-            grid=(b,),
-            in_specs=[pl.BlockSpec((1, rows, width), lambda i: (i, 0, 0))],
-            out_specs=pl.BlockSpec((1, rows, width), lambda i: (i, 0, 0)),
-            out_shape=jax.ShapeDtypeStruct(packed.shape, packed.dtype),
-            interpret=interpret,
-        )(packed)
-
     # compile wall + cost analysis attributed to this kernel site (obs/)
     return _device.instrument_jit("pallas.vmem_bit_batch", run)
 

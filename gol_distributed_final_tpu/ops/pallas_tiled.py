@@ -211,23 +211,32 @@ def _tiled_kernel_rows(
     birth_mask,
     survive_mask,
     interpret,
+    turns=1,
 ):
     # 8-row edge strips only: (1 + 16/pb)x read instead of 3x, and the
     # ext stays sublane-aligned. bit_step's (i+-1, j+-1) word dependency
     # holds for EITHER packing (ops/bitpack.py module docstring), so the
     # same halo geometry serves word_axis=1 — the layout that keeps
-    # packed rows narrow (hence contiguous, fast DMA) on very wide boards
+    # packed rows narrow (hence contiguous, fast DMA) on very wide boards.
+    #
+    # ``turns`` > 1 is the fused-K form (ops/fused.py): each extra step
+    # contaminates one more word-row inward from the ext's edges (the
+    # shrinking dependency cone), so up to _SUBLANE turns can run on the
+    # SAME 8-row halos before the garbage reaches the interior the write
+    # below keeps — K turns per launch from one halo read.
     ext = jnp.concatenate([top_ref[:], body_ref[:], bot_ref[:]], axis=0)
     from .pallas_stencil import pick_rot1
 
-    out = bit_step(
-        ext,
-        word_axis,
-        pick_rot1(interpret),
-        birth_mask=birth_mask,
-        survive_mask=survive_mask,
-    )
-    out_ref[:] = out[_SUBLANE:-_SUBLANE, :]
+    rot1 = pick_rot1(interpret)
+    for _ in range(turns):
+        ext = bit_step(
+            ext,
+            word_axis,
+            rot1,
+            birth_mask=birth_mask,
+            survive_mask=survive_mask,
+        )
+    out_ref[:] = ext[_SUBLANE:-_SUBLANE, :]
 
 
 def _tiled_kernel_2d(
@@ -246,28 +255,34 @@ def _tiled_kernel_2d(
     birth_mask,
     survive_mask,
     interpret,
+    turns=1,
 ):
     # nine views of the same array: body + the eight neighbours' edge
-    # tiles, concatenated into a fully tile-aligned torus window
+    # tiles, concatenated into a fully tile-aligned torus window.
+    # ``turns`` > 1 (fused-K, ops/fused.py): the contamination cone grows
+    # one word-row AND one lane element per step from every ext edge —
+    # the 8-row strips bound K at _SUBLANE, the 128-lane tiles are never
+    # the binding side for K <= 8.
     top = jnp.concatenate([tl_ref[:], top_ref[:], tr_ref[:]], axis=1)
     mid = jnp.concatenate([left_ref[:], body_ref[:], right_ref[:]], axis=1)
     bot = jnp.concatenate([bl_ref[:], bot_ref[:], br_ref[:]], axis=1)
     ext = jnp.concatenate([top, mid, bot], axis=0)
     from .pallas_stencil import pick_rot1
 
-    out = bit_step(
-        ext,
-        word_axis,
-        pick_rot1(interpret),
-        birth_mask=birth_mask,
-        survive_mask=survive_mask,
-    )
-    out_ref[:] = out[_SUBLANE:-_SUBLANE, _LANE:-_LANE]
+    rot1 = pick_rot1(interpret)
+    for _ in range(turns):
+        ext = bit_step(
+            ext,
+            word_axis,
+            rot1,
+            birth_mask=birth_mask,
+            survive_mask=survive_mask,
+        )
+    out_ref[:] = ext[_SUBLANE:-_SUBLANE, _LANE:-_LANE]
 
 
-@functools.lru_cache(maxsize=None)
-def _tiled_compiled(
-    n: int,
+def tiled_pallas_call(
+    turns: int,
     shape: tuple[int, int],
     interpret: bool,
     birth_mask: int = CONWAY_BIRTH_MASK,
@@ -276,8 +291,19 @@ def _tiled_compiled(
     block_cols: int | None = None,
     word_axis: int = 0,
 ):
+    """The RAW grid-tiled launch advancing ``turns`` turns per grid
+    program (1 = the classic per-turn launch; up to ``_SUBLANE`` = the
+    fused-K form, ops/fused.py — the shrinking dependency cone inside the
+    8-row halo strips bounds K). Returns a traceable callable
+    ``int32[rows, width] -> int32[rows, width]``; callers compose it
+    under their own jit + instrumentation."""
     from jax.experimental import pallas as pl
 
+    if not 1 <= turns <= _SUBLANE:
+        raise ValueError(
+            f"tiled launches support 1..{_SUBLANE} fused turns (the 8-row "
+            f"halo strips are the dependency-cone budget), got {turns}"
+        )
     rows, width = shape
     mode, pb, wb = _plan(rows, width, block_rows, block_cols)
     gr, gc = rows // pb, width // wb
@@ -304,6 +330,7 @@ def _tiled_compiled(
         birth_mask=birth_mask,
         survive_mask=survive_mask,
         interpret=interpret,
+        turns=turns,
     )
     if mode == "rows":
         one_turn = pl.pallas_call(
@@ -340,11 +367,28 @@ def _tiled_compiled(
         )
         n_in = 9
 
+    return lambda packed: one_turn(*([packed] * n_in))
+
+
+@functools.lru_cache(maxsize=None)
+def _tiled_compiled(
+    n: int,
+    shape: tuple[int, int],
+    interpret: bool,
+    birth_mask: int = CONWAY_BIRTH_MASK,
+    survive_mask: int = CONWAY_SURVIVE_MASK,
+    block_rows: int | None = None,
+    block_cols: int | None = None,
+    word_axis: int = 0,
+):
+    one_turn = tiled_pallas_call(
+        1, shape, interpret, birth_mask, survive_mask,
+        block_rows, block_cols, word_axis,
+    )
+
     @jax.jit
     def run(packed):
-        return lax.fori_loop(
-            0, n, lambda _, p: one_turn(*([p] * n_in)), packed
-        )
+        return lax.fori_loop(0, n, lambda _, p: one_turn(p), packed)
 
     # compile wall + cost analysis attributed to this kernel site (obs/)
     return _device.instrument_jit("pallas.tiled", run)

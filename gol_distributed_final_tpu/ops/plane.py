@@ -15,6 +15,18 @@ Interface (duck-typed):
     decode(state) -> np.uint8 [H, W]  full host board (Retrieve/final only)
     alive_count(state) -> int         device-side reduction, tiny transfer
 
+Optional fused step+count protocol (ops/fused.FusedBitPlane implements
+it; the engine's chunk driver consumes it — ops/batched planes carry the
+batch twin ``step_n_counts``):
+    step_n_counted(state, n) -> (state, counts)
+                                      n turns AND the alive reduction in
+                                      ONE dispatch; ``counts`` is a
+                                      device vector whose int64 host sum
+                                      (ops/fused.fold_counts) is the
+                                      alive count of the returned state —
+                                      the count-only Retrieve ticker is
+                                      served from it with no dispatch
+
 Optional early-exit protocol (ops/sparse.SparseBitPlane implements it;
 the engine consumes it through :func:`plane_steady_kind`):
     steady_kind(state) -> None | "still" | "period2"

@@ -18,16 +18,37 @@ requests (halo rows derived locally) — the broker chooses per its
 from __future__ import annotations
 
 import argparse
+import os
 import threading
 import time
 
 import numpy as np
 
+from ..obs import instruments as _ins
 from ..utils import locksan as _locksan
 from . import faults as _faults
 from . import integrity as _integrity
 from .protocol import Methods, Request, Response
 from .server import RpcServer
+
+#: dead-band skip engages when the live window (the frontier's K-deep
+#: dependency cone) covers at most this fraction of the padded block's
+#: rows — below it the saved rows dominate the extent scan's cost
+_SKIP_MAX_WINDOW_FRAC = 0.75
+
+#: the fused jax strip path (ops/fused.fused_strip_steps — one dispatch
+#: for the whole K-turn batch) engages for strips at least this many
+#: cells under GOL_WORKER_FUSED=auto: below it, per-dispatch overhead
+#: beats the K numpy passes it replaces
+FUSED_STRIP_MIN_CELLS = 1 << 20
+
+
+def _worker_fused_mode() -> str:
+    """``GOL_WORKER_FUSED``: ``auto`` (default — fused for strips past
+    FUSED_STRIP_MIN_CELLS, dead-band skip preferred when it applies),
+    ``on`` (EVERY batch through the fused path whenever jax imports —
+    overrides the skip), ``off`` (never the fused path)."""
+    return os.environ.get("GOL_WORKER_FUSED", "auto").lower()
 
 
 def _strip_step(padded: np.ndarray) -> np.ndarray:
@@ -71,6 +92,8 @@ def strip_step_batch(
     bottom: np.ndarray,
     k: int,
     attest: bool = False,
+    *,
+    mode: str = "auto",
 ):
     """Advance a resident strip K turns from depth-K halo rows, in
     shrinking form: the (h + 2K)-row padded block loses one row per side
@@ -98,7 +121,23 @@ def strip_step_batch(
     computing wrong rows anywhere in a boundary's dependency cone is
     caught within the batch (≤K turns). The final step's band is empty
     (zero rows — folds only its shape header; k=1 attests the empty
-    band, which still compares)."""
+    band, which still compares).
+
+    Three bit-identical execution paths, routed per batch (``mode`` pins
+    one for tests; every path yields the same strips, counts, AND band
+    digests):
+
+    * ``skip`` — the dead-band skip (the PR 14 named headroom): when the
+      live rows' K-deep dependency cone covers a minority of the block,
+      only that window is stepped — rows outside it are provably dead
+      for all K turns (non-B0: a dead row with dead neighbours stays
+      dead), so the window's zero padding is exact and the saved
+      row-steps are metered on ``gol_strip_rows_skipped_total``.
+    * ``fused`` — big strips route through ops/fused.fused_strip_steps:
+      the whole K-turn shrinking batch as ONE jitted dispatch (the fused
+      kernel under StripStep — PR 5's wire batching and launch fusion
+      compound), bands materialised so the digest fold is byte-identical.
+    * ``dense`` — the reference-shaped numpy loop."""
     h = strip.shape[0]
     if k < 1:
         raise ValueError(f"strip batch needs k >= 1, got {k}")
@@ -108,6 +147,30 @@ def strip_step_batch(
             f"{top.shape} and {bottom.shape}"
         )
     padded = np.concatenate([top, strip, bottom], axis=0)
+    window = None
+    if mode == "auto":
+        fused = _worker_fused_mode()
+        if fused == "on" and _jax_available():
+            # an explicit operator override: EVERY batch takes the fused
+            # one-dispatch path, the dead-band skip included — the knob
+            # exists to pin the routing, not to advise it
+            mode = "fused"
+        else:
+            window = _live_window(padded, k)
+            if window[1] - window[0] <= _SKIP_MAX_WINDOW_FRAC * padded.shape[0]:
+                mode = "skip"
+            elif fused == "auto" and strip.size >= FUSED_STRIP_MIN_CELLS:
+                mode = "fused" if _jax_available() else "dense"
+            else:
+                mode = "dense"
+    if mode == "skip":
+        if window is None:  # pinned mode: the routing scan never ran
+            window = _live_window(padded, k)
+        return _strip_batch_skip(padded, k, h, *window, attest)
+    if mode == "fused":
+        return _strip_batch_fused(padded, k, h, attest)
+    if mode != "dense":
+        raise ValueError(f"unknown strip batch mode {mode!r}")
     counts = []
     at = ab = _integrity.state_new()
     for i in range(k):
@@ -126,6 +189,103 @@ def strip_step_batch(
             _integrity.state_hex(at), _integrity.state_hex(ab),
         )
     return padded, counts
+
+
+def _jax_available() -> bool:
+    try:
+        import jax  # noqa: F401  (the fused path imports it for real)
+
+        return True
+    except Exception:
+        return False
+
+
+def _live_window(padded: np.ndarray, k: int) -> tuple[int, int]:
+    """The frontier's K-deep dependency cone as a row window [lo, hi):
+    every row outside it is dead at turn t AND at distance > K from any
+    live row, so it stays dead through all K steps (Conway is non-B0 —
+    a dead row with dead neighbours never births). (0, 0) when the whole
+    block is dead."""
+    live = np.flatnonzero(padded.any(axis=1))
+    if live.size == 0:
+        return 0, 0
+    return (
+        max(0, int(live[0]) - k),
+        min(padded.shape[0], int(live[-1]) + 1 + k),
+    )
+
+
+def _strip_batch_skip(padded, k: int, h: int, a_lo: int, a_hi: int, attest):
+    """The dead-band skip: step ONLY the live window, reconstruct every
+    full-block artifact (strip, counts, attestation bands) from it.
+
+    Rows outside [a_lo, a_hi) are dead for all K steps, so stepping the
+    window between zero pads is exact there; where the window touches the
+    block's EDGE, the zero pad stands in for halo data the dense path
+    also discards — the resulting garbage cone reaches at most row j-1
+    by step j, strictly outside both the strip rows [K, K+h) and that
+    step's attestation bands (which start at row j), so every value any
+    output reads is identical to the dense computation's."""
+    H, w = padded.shape
+    _ins.STRIP_ROWS_SKIPPED_TOTAL.inc((H - (a_hi - a_lo)) * k)
+    zero = np.zeros((1, w), np.uint8)
+    active = np.array(padded[a_lo:a_hi], np.uint8)
+
+    def materialize(lo: int, hi: int) -> np.ndarray:
+        out = np.zeros((max(0, hi - lo), w), np.uint8)
+        o_lo, o_hi = max(lo, a_lo), min(hi, a_hi)
+        if o_hi > o_lo:
+            out[o_lo - lo : o_hi - lo] = active[o_lo - a_lo : o_hi - a_lo]
+        return out
+
+    counts = []
+    at = ab = _integrity.state_new()
+    for i in range(k):
+        if active.shape[0]:
+            # constant-size: the zero pads replace the rows the dense
+            # shrinking form consumes (provably dead, or discarded cone)
+            active = _strip_step(np.concatenate([zero, active, zero], axis=0))
+        s_lo, s_hi = max(k, a_lo), min(k + h, a_hi)
+        counts.append(
+            int(np.count_nonzero(active[s_lo - a_lo : s_hi - a_lo]))
+            if s_hi > s_lo
+            else 0
+        )
+        if attest:
+            band = 2 * (k - (i + 1))
+            step = i + 1
+            at = _integrity.state_add(at, materialize(step, step + band))
+            ab = _integrity.state_add(
+                ab, materialize(H - step - band, H - step)
+            )
+    final = materialize(k, k + h)
+    if attest:
+        return (
+            final, counts,
+            _integrity.state_hex(at), _integrity.state_hex(ab),
+        )
+    return final, counts
+
+
+def _strip_batch_fused(padded, k: int, h: int, attest):
+    """The fused jax path: ops/fused.fused_strip_steps runs the whole
+    shrinking K-turn batch as one dispatch; the per-step bands come back
+    materialised so the rolling digest fold is byte-identical to the
+    dense path's (the broker's cross-attestation never sees a routing
+    difference)."""
+    from ..ops.fused import fused_strip_steps
+
+    strip, counts, bands = fused_strip_steps(padded, k, h, attest=attest)
+    if attest:
+        at = ab = _integrity.state_new()
+        for band_top, band_bot in bands:
+            at = _integrity.state_add(at, band_top)
+            ab = _integrity.state_add(ab, band_bot)
+        return (
+            strip, counts,
+            _integrity.state_hex(at), _integrity.state_hex(ab),
+        )
+    return strip, counts
 
 
 class WorkerService:

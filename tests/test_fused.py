@@ -1,0 +1,481 @@
+"""Fused K-turns-per-launch suite (ISSUE 15).
+
+Covers the whole fused tier (ops/fused.py) and its consumers:
+
+* ladder arithmetic + the pow2 K quantiser (chunk churn never recompiles);
+* oracle bit-parity of the fused entry points vs the serial kernels across
+  K ∈ {1, 2, 4, 8}, odd remainders, the three test_wire geometries, the
+  HighLife rule, and both packings;
+* the grid-tiled fused kernels (bit rows/grid2d + byte strips) with forced
+  block shapes — the shrinking-cone-in-the-halo-strips form;
+* the batched grid variant vs per-universe loops, and the fused
+  step+count programs on both batched planes;
+* the engine's counted chunk driver (host-free alive fold, dispatch-free
+  ticker retrieve) and the session table's step_n_counts chunk path;
+* the resident worker's three StripStep paths (dense / dead-band skip /
+  fused) — strips, counts, AND attestation digests bit-identical;
+* ops/auto routing (fused_bitplane label, GOL_FUSED knob), the launch
+  meters, the analysis jit-cache checker's fused entries, the
+  dispatches_per_turn regress gate, and the README fused lint.
+
+Run standalone via ``scripts/check --fused``.
+"""
+
+import numpy as np
+import pytest
+
+from gol_distributed_final_tpu.models import CONWAY, LifeRule
+from gol_distributed_final_tpu.obs import metrics as obs_metrics
+from gol_distributed_final_tpu.ops import bitpack
+from gol_distributed_final_tpu.ops.fused import (
+    FUSED_MAX_K,
+    FusedBitPlane,
+    _ladder,
+    can_tile_byte,
+    fold_counts,
+    fused_bit_step_n,
+    fused_bit_step_n_batch,
+    fused_step_n,
+    fused_strip_steps,
+    quantise_k,
+)
+
+from oracle import vector_step
+
+HIGHLIFE = LifeRule.from_rulestring("B36/S23", name="highlife")
+
+#: the resident-wire parity geometries (tests/test_wire.py): uneven split
+#: shapes, none 32-row-divisible — the byte tier's bread and butter
+WIRE_GEOMETRIES = [(24, 33), (64, 64), (16, 40)]
+
+
+def _rand_board(h, w, seed=0, density=0.3):
+    rng = np.random.default_rng(seed)
+    return np.where(rng.random((h, w)) < density, 255, 0).astype(np.uint8)
+
+
+def _oracle(board, n, birth=(3,), survive=(2, 3)):
+    for _ in range(n):
+        board = vector_step(board, birth, survive)
+    return board
+
+
+@pytest.fixture
+def live_metrics():
+    reg = obs_metrics.registry()
+    reg.reset()
+    obs_metrics.enable()
+    yield reg
+    obs_metrics.enable(False)
+    reg.reset()
+
+
+def _metric(name, labels=()):
+    for fam in obs_metrics.registry().snapshot()["families"]:
+        if fam["name"] == name:
+            for s in fam["series"]:
+                if tuple(s.get("labels", ())) == tuple(labels):
+                    return s["value"]
+    return 0.0
+
+
+# -- quantiser + ladder -------------------------------------------------------
+
+
+def test_quantise_k_is_pow2_and_clamped():
+    assert [quantise_k(v) for v in (1, 2, 3, 5, 7, 8, 9, 1000)] == [
+        1, 2, 2, 4, 4, 8, 8, 8,
+    ]
+    assert quantise_k(0) == 1 and quantise_k(-3) == 1
+    assert quantise_k(FUSED_MAX_K) == FUSED_MAX_K
+
+
+def test_ladder_covers_n_exactly_with_bounded_stages():
+    for n in (1, 7, 8, 13, 137, 4096):
+        for k in (1, 2, 4, 8):
+            full, rems = _ladder(n, k)
+            assert full * k + sum(rems) == n
+            # remainder stages are distinct pow2 < k: the compile-key set
+            # is bounded by log2(k)+1 regardless of n churn
+            assert all(r < k and r & (r - 1) == 0 for r in rems)
+            assert len(set(rems)) == len(rems)
+
+
+# -- fused bitboard parity ----------------------------------------------------
+
+
+@pytest.mark.parametrize("k", [1, 2, 4, 8])
+@pytest.mark.parametrize("n", [1, 5, 13])
+def test_fused_bit_parity_vs_oracle(k, n):
+    """fused-K == serial == numpy oracle, odd remainders included (the
+    pow2 remainder ladder is in the path for every n % k != 0)."""
+    board = _rand_board(64, 64, seed=k * 100 + n)
+    packed = bitpack.pack(board, 0)
+    got = fused_bit_step_n(packed, n, k=k, interpret=True)
+    want_serial = bitpack.bit_step_n(packed, n, 0)
+    assert np.array_equal(np.asarray(got), np.asarray(want_serial))
+    assert np.array_equal(
+        bitpack.unpack(np.asarray(got), 0), _oracle(board, n)
+    )
+
+
+def test_fused_bit_word_axis1_parity():
+    board = _rand_board(40, 64, seed=3)  # h not 32-divisible: packs cols
+    packed = bitpack.pack(board, 1)
+    got = fused_bit_step_n(packed, 11, k=4, word_axis=1, interpret=True)
+    assert np.array_equal(bitpack.unpack(np.asarray(got), 1), _oracle(board, 11))
+
+
+def test_fused_highlife_parity():
+    board = _rand_board(64, 64, seed=5, density=0.4)
+    packed = bitpack.pack(board, 0)
+    got = fused_bit_step_n(packed, 9, k=4, rule=HIGHLIFE, interpret=True)
+    assert np.array_equal(
+        bitpack.unpack(np.asarray(got), 0),
+        _oracle(board, 9, birth=(3, 6), survive=(2, 3)),
+    )
+
+
+@pytest.mark.parametrize("blocks", [dict(block_rows=8), dict(block_rows=8, block_cols=128)])
+@pytest.mark.parametrize("k", [2, 8])
+def test_fused_tiled_parity(blocks, k):
+    """The grid-tiled fused kernel (rows AND grid2d regimes via forced
+    block shapes): K steps per grid program on the 8-row halo strips,
+    shrinking cone discarded — bit-identical to the serial kernel."""
+    board = _rand_board(512, 256, seed=k)
+    packed = bitpack.pack(board, 0)  # (16, 256): multi-block both ways
+    got = fused_bit_step_n(packed, 13, k=k, interpret=True, **blocks)
+    assert np.array_equal(
+        np.asarray(got), np.asarray(bitpack.bit_step_n(packed, 13, 0))
+    )
+
+
+def test_tiled_launch_rejects_k_past_the_cone():
+    from gol_distributed_final_tpu.ops.pallas_tiled import tiled_pallas_call
+
+    with pytest.raises(ValueError, match="fused turns"):
+        tiled_pallas_call(9, (16, 256), True)
+
+
+# -- fused byte tier ----------------------------------------------------------
+
+
+@pytest.mark.parametrize("geometry", WIRE_GEOMETRIES)
+def test_fused_byte_parity_wire_geometries(geometry):
+    h, w = geometry
+    board = _rand_board(h, w, seed=h + w)
+    got = fused_step_n(board, 13, k=4, interpret=True)
+    assert np.array_equal(np.asarray(got), _oracle(board, 13))
+
+
+def test_fused_byte_tiled_parity():
+    from gol_distributed_final_tpu.ops.fused import _fused_byte_tiled_compiled
+
+    shape = (64, 128)
+    assert can_tile_byte(shape)
+    board = _rand_board(*shape, seed=11)
+    fn = _fused_byte_tiled_compiled(
+        13, 4, shape, CONWAY.birth_mask, CONWAY.survive_mask, True
+    )
+    got = fn(np.asarray(board))
+    assert np.array_equal(np.asarray(got), _oracle(board, 13))
+
+
+# -- batched grid variant + fused counts --------------------------------------
+
+
+def _mixed_batch(size=64, seed=7):
+    dense = _rand_board(size, size, seed=seed)
+    glider = np.zeros((size, size), np.uint8)
+    for y, x in ((1, 2), (2, 3), (3, 1), (3, 2), (3, 3)):
+        glider[y, x] = 255
+    return np.stack([dense, np.zeros((size, size), np.uint8), glider])
+
+
+def test_fused_batch_parity_vs_per_universe():
+    boards = _mixed_batch()
+    packed = np.stack([np.asarray(bitpack.pack(b, 0)) for b in boards])
+    import jax.numpy as jnp
+
+    got = fused_bit_step_n_batch(jnp.asarray(packed), 13, k=8, interpret=True)
+    for i, b in enumerate(boards):
+        solo = fused_bit_step_n(bitpack.pack(b, 0), 13, k=8, interpret=True)
+        assert np.array_equal(np.asarray(got)[i], np.asarray(solo))
+        assert np.array_equal(
+            bitpack.unpack(np.asarray(got)[i], 0), _oracle(b, 13)
+        )
+
+
+@pytest.mark.parametrize("plane_kind", ["bit", "byte"])
+def test_step_n_counts_matches_step_then_count(plane_kind):
+    """The fused chunk program == step_n followed by alive_counts, for
+    both batched tiers — the sessions hot path's one-dispatch form."""
+    from gol_distributed_final_tpu.ops.batched import (
+        BatchBitPlane,
+        BatchBytePlane,
+    )
+
+    boards = _mixed_batch(size=64 if plane_kind == "bit" else 30)
+    plane = BatchBitPlane(CONWAY, 0) if plane_kind == "bit" else BatchBytePlane(CONWAY)
+    state = plane.encode(boards)
+    out, counts = plane.step_n_counts(state, 7)
+    want = plane.step_n(state, 7)
+    assert np.array_equal(plane.decode(out), plane.decode(want))
+    assert counts.dtype == np.int64
+    assert np.array_equal(counts, plane.alive_counts(want))
+
+
+def test_fused_plane_counted_and_fold():
+    board = _rand_board(64, 64, seed=13)
+    plane = FusedBitPlane(CONWAY, 0)
+    state = plane.encode(board)
+    out, counts = plane.step_n_counted(state, 9)
+    assert np.array_equal(np.asarray(out), np.asarray(plane.step_n(state, 9)))
+    assert fold_counts(counts) == plane.alive_count(out)
+    assert fold_counts(counts) == int(np.count_nonzero(_oracle(board, 9)))
+
+
+# -- engine counted driver ----------------------------------------------------
+
+
+def test_engine_counted_driver_and_dispatch_free_ticker(monkeypatch):
+    """The engine's chunk driver consumes step_n_counted (the fused
+    step+count dispatch) and the count-only Retrieve is served from the
+    committed fold — no reduction dispatch at all."""
+    from gol_distributed_final_tpu.engine.engine import Engine, EngineConfig
+    from gol_distributed_final_tpu.params import Params
+
+    board = _rand_board(64, 64, seed=17)
+    calls = {"counted": 0}
+    orig = FusedBitPlane.step_n_counted
+
+    def spy(self, state, n):
+        calls["counted"] += 1
+        return orig(self, state, n)
+
+    monkeypatch.setattr(FusedBitPlane, "step_n_counted", spy)
+    engine = Engine(EngineConfig())
+    res = engine.run(Params(turns=37, image_width=64, image_height=64), board)
+    assert calls["counted"] >= 1
+    want = _oracle(board, 37)
+    assert np.array_equal(res.world, want)
+
+    # the ticker path: the plane-side reduction must NOT run — the count
+    # comes from the fold committed with the final chunk
+    monkeypatch.setattr(
+        FusedBitPlane,
+        "alive_count",
+        lambda self, state: pytest.fail("ticker paid a reduction dispatch"),
+    )
+    snap = engine.retrieve(include_world=False)
+    assert snap.turns_completed == 37
+    assert snap.alive_count == int(np.count_nonzero(want))
+
+
+def test_sessions_advance_uses_fused_counts(monkeypatch):
+    from gol_distributed_final_tpu.engine.sessions import SessionTable
+    from gol_distributed_final_tpu.ops.batched import BatchBitPlane
+
+    calls = {"counts": 0}
+    orig = BatchBitPlane.step_n_counts
+
+    def spy(self, state, n):
+        calls["counts"] += 1
+        return orig(self, state, n)
+
+    monkeypatch.setattr(BatchBitPlane, "step_n_counts", spy)
+    boards = _mixed_batch()
+    table = SessionTable(CONWAY, (64, 64), capacity=8)
+    sessions = [table.admit(b, 25) for b in boards]
+    while table.advance():
+        pass
+    assert calls["counts"] >= 1
+    for sess, b in zip(sessions, boards):
+        assert sess.done.is_set()
+        assert np.array_equal(sess.result, _oracle(b, 25))
+        assert sess.alive_count == int(np.count_nonzero(sess.result))
+
+
+# -- the resident worker's strip paths ----------------------------------------
+
+
+def _strip_scenarios(k, w=48, h=40):
+    rng = np.random.default_rng(k)
+    z = np.zeros((k, w), np.uint8)
+    dense = np.where(rng.random((h, w)) < 0.3, 255, 0).astype(np.uint8)
+    top = np.where(rng.random((k, w)) < 0.3, 255, 0).astype(np.uint8)
+    bot = np.where(rng.random((k, w)) < 0.3, 255, 0).astype(np.uint8)
+    glider = np.zeros((h, w), np.uint8)
+    for y, x in ((1, 2), (2, 3), (3, 1), (3, 2), (3, 3)):
+        glider[18 + y, 20 + x] = 255
+    edge = np.zeros((h, w), np.uint8)
+    edge[0, 5:8] = 255
+    return [
+        ("dense", dense, top, bot),
+        ("glider-mid", glider, z, z),
+        ("halo-live-only", np.zeros((h, w), np.uint8), top, bot),
+        ("strip-edge", edge, z, z),
+        ("all-dead", np.zeros((h, w), np.uint8), z, z),
+    ]
+
+
+@pytest.mark.parametrize("k", [1, 2, 4, 8])
+def test_strip_paths_bit_identical_including_digests(k):
+    """dense / skip / fused / auto all yield the same strip, the same
+    per-step counts, AND the same attestation digests — the broker's
+    cross-attestation can never tell the routing apart."""
+    from gol_distributed_final_tpu.rpc import integrity as _integrity
+    from gol_distributed_final_tpu.rpc.worker import strip_step_batch
+
+    _integrity.set_enabled(True)
+    for label, strip, top, bot in _strip_scenarios(k):
+        dense = strip_step_batch(strip, top, bot, k, attest=True, mode="dense")
+        for mode in ("skip", "fused", "auto"):
+            got = strip_step_batch(strip, top, bot, k, attest=True, mode=mode)
+            assert np.array_equal(dense[0], got[0]), (label, mode)
+            assert dense[1] == got[1], (label, mode)
+            assert dense[2:] == got[2:], (label, mode)
+
+
+def test_strip_skip_meters_saved_rows(live_metrics):
+    from gol_distributed_final_tpu.rpc.worker import strip_step_batch
+
+    k, h, w = 4, 64, 32
+    strip = np.zeros((h, w), np.uint8)
+    for y, x in ((1, 2), (2, 3), (3, 1), (3, 2), (3, 3)):
+        strip[30 + y, 10 + x] = 255
+    z = np.zeros((k, w), np.uint8)
+    before = _metric("gol_strip_rows_skipped_total")
+    out, counts = strip_step_batch(strip, z, z, k)  # auto -> skip
+    after = _metric("gol_strip_rows_skipped_total")
+    assert after > before
+    assert out.shape == strip.shape
+    # parity vs the dense path
+    want, want_counts = strip_step_batch(strip, z, z, k, mode="dense")
+    assert np.array_equal(out, want) and counts == want_counts
+
+
+def test_worker_fused_env_knob(monkeypatch):
+    from gol_distributed_final_tpu.rpc import worker as w
+
+    monkeypatch.setenv("GOL_WORKER_FUSED", "off")
+    assert w._worker_fused_mode() == "off"
+    monkeypatch.delenv("GOL_WORKER_FUSED")
+    assert w._worker_fused_mode() == "auto"
+    # unknown mode kwarg refuses loudly
+    strip = _rand_board(8, 8, seed=1)
+    halo = np.zeros((1, 8), np.uint8)
+    with pytest.raises(ValueError, match="mode"):
+        w.strip_step_batch(strip, halo, halo, 1, mode="warp")
+
+
+# -- routing + meters ---------------------------------------------------------
+
+
+def test_auto_plane_routes_fused_tier(live_metrics, monkeypatch):
+    from gol_distributed_final_tpu.ops.auto import auto_plane
+    from gol_distributed_final_tpu.ops.plane import BitPlane
+
+    shape = (64, 416)  # unique shape: selection cache is cold
+    before = _metric("gol_ops_plane_selected_total", ("fused_bitplane",))
+    plane = auto_plane(CONWAY, shape)
+    assert isinstance(plane, FusedBitPlane)
+    assert _metric(
+        "gol_ops_plane_selected_total", ("fused_bitplane",)
+    ) == before + 1
+    # the knob restores the classic tier (fresh shape: decisions cache)
+    monkeypatch.setenv("GOL_FUSED", "off")
+    classic = auto_plane(CONWAY, (64, 448))
+    assert isinstance(classic, BitPlane) and not isinstance(
+        classic, FusedBitPlane
+    )
+
+
+def test_fused_launch_meters(live_metrics):
+    packed = bitpack.pack(_rand_board(64, 64, seed=23), 0)
+    before = _metric("gol_fused_launches_total")
+    fused_bit_step_n(packed, 13, k=8, interpret=True)
+    after = _metric("gol_fused_launches_total")
+    full, rems = _ladder(13, 8)
+    assert after - before == full + len(rems)
+    # the K histogram saw every stage
+    for fam in obs_metrics.registry().snapshot()["families"]:
+        if fam["name"] == "gol_fused_turns_per_launch":
+            counts = sum(s.get("count", 0) for s in fam["series"])
+            assert counts >= full + len(rems)
+
+
+# -- analysis: the fused entries ride the jit-cache checker -------------------
+
+
+def test_jit_cache_checker_covers_fused_entries():
+    import textwrap
+
+    from gol_distributed_final_tpu.analysis import core
+    from gol_distributed_final_tpu.analysis.jit import JitCacheChecker
+
+    def findings_for(src):
+        found, _ = core.analyze_source(
+            textwrap.dedent(src), "ops/mod.py", [JitCacheChecker()]
+        )
+        return found
+
+    flagged = findings_for("""
+        def drive(packed, budgets):
+            n = min(budgets)
+            return fused_bit_step_n(packed, n)
+    """)
+    assert len(flagged) == 1 and "un-quantised" in flagged[0].message
+    # the static K kwarg is the same hazard (fused_strip_steps has no
+    # positional turn arg in this call shape)
+    flagged_k = findings_for("""
+        def drive(padded, budgets, h):
+            return fused_strip_steps(padded, k=min(budgets), strip_rows=h)
+    """)
+    assert len(flagged_k) == 1
+    clean = findings_for("""
+        def drive(packed, budgets):
+            n = min(budgets)
+            if n > 2:
+                n = 1 << (n.bit_length() - 1)
+            return fused_bit_step_n(packed, n)
+    """)
+    assert clean == []
+
+
+# -- regress: the deterministic launch-floor gate -----------------------------
+
+
+def test_regress_gates_dispatches_per_turn():
+    from gol_distributed_final_tpu.obs.regress import compare_case
+
+    old = {"per_turn_us": 1.0, "dispatches_per_turn": 0.125}
+    grown = {"per_turn_us": 1.0, "dispatches_per_turn": 1.0}
+    out = compare_case(old, grown)
+    assert out["verdict"] == "REGRESSED"
+    assert "dispatches" in out["why"]
+    # steady launches stay clean; improvement never gates
+    assert compare_case(old, dict(old))["verdict"] != "REGRESSED"
+    better = {"per_turn_us": 1.0, "dispatches_per_turn": 0.0625}
+    assert compare_case(old, better)["verdict"] != "REGRESSED"
+    # deterministic: gates even when a wall-clock side is unusable
+    broken = {"per_turn_us": 0.0, "dispatches_per_turn": 1.0}
+    assert compare_case(old, broken)["verdict"] == "REGRESSED"
+
+
+# -- lint: the Fused stepping section is the doc of record --------------------
+
+
+def test_fused_lint_both_ways(tmp_path):
+    from gol_distributed_final_tpu.obs.lint import (
+        _FUSED_DOC_NAMES,
+        undocumented_fused_names,
+    )
+
+    assert undocumented_fused_names() == []  # the shipped README passes
+    stripped = tmp_path / "README.md"
+    stripped.write_text(
+        "# x\n\n## Fused stepping\n\nnothing here\n\n## Next\n"
+        + "\n".join(_FUSED_DOC_NAMES)  # named OUTSIDE the section: no credit
+    )
+    assert undocumented_fused_names(stripped) == sorted(_FUSED_DOC_NAMES)

@@ -197,11 +197,13 @@ def test_auto_plane_selection_hoisted_once_per_decision(live_metrics):
     from gol_distributed_final_tpu.ops.auto import auto_batch_plane, auto_plane
 
     shape = (96, 544)  # unique: never used elsewhere, so the cache is cold
-    before = _metric("gol_ops_plane_selected_total", ("bitplane",))
+    # VMEM-fit bitboards now select the fused tier (ISSUE 15: its own
+    # label, so the roofline attributes fused sites separately)
+    before = _metric("gol_ops_plane_selected_total", ("fused_bitplane",))
     p1 = auto_plane(CONWAY, shape)
     for _ in range(50):  # a hot admission loop
         assert auto_plane(CONWAY, shape) is p1
-    after = _metric("gol_ops_plane_selected_total", ("bitplane",))
+    after = _metric("gol_ops_plane_selected_total", ("fused_bitplane",))
     assert after - before == 1
     bshape = (96, 576)
     before = _metric("gol_ops_plane_selected_total", ("batch_bitplane",))
