@@ -216,8 +216,12 @@ def _bench_wire_modes(extra: dict) -> int:
     gates the COMMS trajectory, not just wall-clock. The resident-vs-
     haloed byte ratio is a hard gate here (≥ 10×): byte accounting is
     deterministic, unlike loopback timing."""
+    import shutil
+    import tempfile
+
     import numpy as np
 
+    from gol_distributed_final_tpu.obs import journal as obs_journal
     from gol_distributed_final_tpu.obs import metrics as obs_metrics
     from gol_distributed_final_tpu.obs import perf as obs_perf
     from gol_distributed_final_tpu.obs import timeline as obs_timeline
@@ -238,33 +242,42 @@ def _bench_wire_modes(extra: dict) -> int:
     rng = np.random.default_rng(1)
     board = np.where(rng.random((size, size)) < 0.3, 255, 0).astype(np.uint8)
     want100 = None  # cross-mode parity reference (100 turns)
+    jdir = tempfile.mkdtemp(prefix="gol_bench_journal_")
     try:
-        for wire, k, key, n_lo, n_hi, check, timeline, attribution in (
-            ("full", 1, "c7_wire_full", 30, 230, True, False, True),
-            ("haloed", 1, "c7_wire_haloed", 30, 230, True, False, True),
+        for wire, k, key, n_lo, n_hi, check, timeline, attribution, journal in (
+            ("full", 1, "c7_wire_full", 30, 230, True, False, True, False),
+            ("haloed", 1, "c7_wire_haloed", 30, 230, True, False, True, False),
             # resident turns are much cheaper per RPC: wider endpoints so
             # the marginal work still dominates loopback timing noise
-            ("resident", 1, "c7_wire_resident_k1", 100, 1100, True, False, True),
-            ("resident", 8, "c7_wire_resident_k8", 100, 1100, True, False, True),
+            ("resident", 1, "c7_wire_resident_k1", 100, 1100, True, False, True, False),
+            ("resident", 8, "c7_wire_resident_k8", 100, 1100, True, False, True, False),
             # the same case UNDEFENDED (-integrity off, both sides): the
             # checked case above pays the in-header frame crcs + adler32
             # attestations, so the pair prices the integrity layer — the
             # overhead gate below holds it under 3% of resident turn cost
-            ("resident", 8, "c7_wire_resident_k8_nock", 100, 1100, False, False, True),
+            ("resident", 8, "c7_wire_resident_k8_nock", 100, 1100, False, False, True, False),
             # the same case with the -timeline sampler ON (1 s cadence,
             # the serving default): prices the always-on history + SLO
             # evaluation; the overhead gate below holds it under 2%
-            ("resident", 8, "c7_wire_resident_k8_timeline", 100, 1100, True, True, True),
+            ("resident", 8, "c7_wire_resident_k8_timeline", 100, 1100, True, True, True, False),
             # the same case with the dispatch-wall decomposition + the
             # critical-path attribution OFF (obs/perf.set_attribution):
             # the on-vs-off pair prices the WHERE-TIME-GOES layer; the
             # overhead gate below holds it under 2%
-            ("resident", 8, "c7_wire_resident_k8_noattr", 100, 1100, True, False, False),
+            ("resident", 8, "c7_wire_resident_k8_noattr", 100, 1100, True, False, False, False),
+            # the same case with the durable lifecycle journal ON
+            # (obs/journal.py: hot-path record() calls + the buffered
+            # segment writer, flushing to a throwaway dir): prices the
+            # "-journal in production" story; the overhead gate below
+            # holds it under 2% of resident turn cost
+            ("resident", 8, "c7_wire_resident_k8_journal", 100, 1100, True, False, True, True),
         ):
             _integrity.set_enabled(check)
             obs_perf.set_attribution(attribution)
             if timeline:
                 obs_timeline.enable(period=1.0)
+            if journal:
+                obs_journal.enable(out_dir=jdir, role="bench")
             backend = WorkersBackend(addrs, wire=wire, halo_depth=k)
             try:
                 def evolve(n, backend=backend):
@@ -297,6 +310,8 @@ def _bench_wire_modes(extra: dict) -> int:
                 backend.close()
                 if timeline:
                     obs_timeline.disable()
+                if journal:
+                    obs_journal.disable()
         print("parity wire modes ok (100 turns, cross-mode)", file=sys.stderr)
         hal = extra["c7_wire_haloed"]["wire_bytes_per_turn"]
         res8 = extra["c7_wire_resident_k8"]["wire_bytes_per_turn"]
@@ -397,10 +412,39 @@ def _bench_wire_modes(extra: dict) -> int:
             f"band {2 * na_noise_us:.2f} us)",
             file=sys.stderr,
         )
+        # journal overhead gate: journal-on vs journal-off resident K=8,
+        # the same noise-band posture — the durable lifecycle journal
+        # (one record per chunk commit plus the buffered segment writer)
+        # must stay under 2% of resident turn cost or the "persistent
+        # universes run -journal always" story dies here
+        jn = extra["c7_wire_resident_k8_journal"]
+        pt_jn = jn["per_turn_us"]
+        jn_noise_us = sum(
+            c["spread_s"] / (c["n_hi"] - c["n_lo"]) * 1e6 for c in (ck, jn)
+        )
+        journal_overhead_pct = (pt_jn - pt_ck) / pt_ck * 100.0
+        jn["journal_overhead_pct"] = round(journal_overhead_pct, 2)
+        if pt_jn - pt_ck > 0.02 * pt_ck + 2 * jn_noise_us:
+            print(
+                f"JOURNAL OVERHEAD GATE FAILURE: journal-on resident k8 "
+                f"{pt_jn:.2f} us/turn vs off {pt_ck:.2f} "
+                f"({journal_overhead_pct:+.1f}%) exceeds 2% beyond the "
+                f"{jn_noise_us:.2f} us noise band",
+                file=sys.stderr,
+            )
+            return 1
+        print(
+            f"journal overhead ok: journal on {pt_jn:.2f} us/turn vs "
+            f"off {pt_ck:.2f} ({journal_overhead_pct:+.1f}%, band "
+            f"{2 * jn_noise_us:.2f} us)",
+            file=sys.stderr,
+        )
     finally:
         _integrity.set_enabled(True)
         obs_perf.set_attribution(True)
         obs_timeline.disable()
+        obs_journal.disable()
+        shutil.rmtree(jdir, ignore_errors=True)
         for server, _service in servers:
             server.stop()
     return 0

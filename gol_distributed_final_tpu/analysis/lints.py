@@ -48,6 +48,14 @@ _SECTION_ANCHORS = {
     "lint-slo-rules": "## SLOs & alerting",
     "lint-canary-metrics": "## Canary & load harness",
     "lint-accounting-docs": "## Accounting & capacity",
+    "lint-perf-metrics": "## Performance attribution",
+    "lint-sparse-metrics": "## Sparse stepping",
+    "lint-fused-metrics": "## Fused stepping",
+    "lint-journal-metrics": "## Journal & history",
+    # lint-journal-kinds anchors on the journal section too: a drifted
+    # kind means the README's event-kind table is stale alongside the
+    # EVENT_KINDS dict
+    "lint-journal-kinds": "## Journal & history",
 }
 
 

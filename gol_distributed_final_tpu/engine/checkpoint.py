@@ -30,6 +30,7 @@ import numpy as np
 
 from ..models import CONWAY, LifeRule
 from ..obs import instruments as _ins
+from ..obs import journal as _journal
 
 CKPT_FORMAT_VERSION = 2
 
@@ -202,10 +203,14 @@ def load_verified_checkpoint(path) -> tuple[np.ndarray, int, LifeRule]:
                 "fall back to an older generation (-ckpt-keep)",
                 kind="digest",
             )
-    except CheckpointError:
+    except CheckpointError as exc:
         _ins.CKPT_VERIFY_TOTAL.labels("fail").inc()
+        _journal.record(
+            "ckpt.verify", "fail", path=str(path), kind=exc.kind
+        )
         raise
     _ins.CKPT_VERIFY_TOTAL.labels("ok").inc()
+    _journal.record("ckpt.verify", "ok", path=str(path), turn=turn)
     return board, turn, rule
 
 
@@ -269,7 +274,12 @@ def load_resume_checkpoint(path, keep: int = 1) -> tuple[np.ndarray, int, LifeRu
             except CheckpointError as exc:
                 attempts.append(f"{dpath}: [{exc.kind}] {exc}")
                 continue
+            _journal.record(
+                "ckpt.replay", "delta", turn=turn_d, gen=gen,
+                base_turn=turn,
+            )
             return board_d, turn_d, rule, gen
+        _journal.record("ckpt.replay", "full", turn=turn, gen=gen)
         return board, turn, rule, gen
     raise CheckpointError(
         "no verifiable checkpoint generation to resume from:\n  "
@@ -449,10 +459,14 @@ def apply_delta_checkpoint(
                 "corrupt; resume falls back to the full generation",
                 kind="digest",
             )
-    except CheckpointError:
+    except CheckpointError as exc:
         _ins.CKPT_VERIFY_TOTAL.labels("fail").inc()
+        _journal.record(
+            "ckpt.verify", "fail", path=str(path), kind=exc.kind
+        )
         raise
     _ins.CKPT_VERIFY_TOTAL.labels("ok").inc()
+    _journal.record("ckpt.verify", "ok", path=str(path), turn=turn)
     return board, turn
 
 

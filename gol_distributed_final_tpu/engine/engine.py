@@ -41,6 +41,7 @@ from ..models import CONWAY, LifeRule
 from ..obs import device as _device
 from ..obs import flight as _flight
 from ..obs import instruments as _ins
+from ..obs import journal as _journal
 from ..obs import metrics as _metrics
 from ..obs import perf as _perf
 from ..obs import timeline as _timeline
@@ -335,6 +336,10 @@ class Engine:
                 )
 
         try:
+            _journal.record(
+                "run.start", "engine", turns=int(params.turns),
+                initial_turn=initial_turn,
+            )
             if emit_flips and emit is not None:
                 for c in alive_cells(world):
                     emit(CellFlipped(0, c))
@@ -532,6 +537,16 @@ class Engine:
                         self._sync_host()
                         new_host = self._world_host
 
+                # journal outside the lock: one record per chunk boundary
+                # (the journal is opt-in; off, this is one global load)
+                _journal.record(
+                    "chunk.commit", "engine", k=n, turn=turn_now,
+                    route="early" if early else (
+                        "fused" if chunk_counts is not None else "plain"
+                    ),
+                )
+                if early:
+                    _journal.record("early.exit", early, turn=turn_now)
                 if emit_flips and emit is not None:
                     changed = np.nonzero(prev_host != new_host)
                     for y, x in zip(*changed):
@@ -563,6 +578,7 @@ class Engine:
                     attempt_ok = True
                     try:
                         self._write_checkpoint(new_state, turn_now)
+                        _journal.record("ckpt.write", "engine", turn=turn_now)
                     except Exception as exc:
                         # catch EVERYTHING, not just OSError: a full disk
                         # must not abort the multi-hour run this checkpoint
@@ -611,8 +627,12 @@ class Engine:
             # opted in, never raises) before propagating, so a crashed or
             # desynced rank leaves its post-mortem on disk
             _flight.dump_on_crash(exc)
+            # same posture for the journal: flush the buffered writer and
+            # record the crash event before propagating (never raises)
+            _journal.flush_on_crash(exc)
             raise
         finally:
+            _journal.record("run.end", "engine", turn=self._turn)
             with self._lock:
                 self._running = False
                 self._paused = False

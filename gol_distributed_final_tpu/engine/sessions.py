@@ -54,6 +54,7 @@ from ..events import AliveCellsCount, FinalTurnComplete, TurnComplete
 from ..models import CONWAY, LifeRule
 from ..obs import accounting as _acct
 from ..obs import instruments as _ins
+from ..obs import journal as _journal
 from ..obs import metrics as _metrics
 from ..obs import perf as _perf
 from ..utils import locksan as _locksan
@@ -72,11 +73,17 @@ class SessionRejected(RuntimeError):
         self.reason = reason
 
 
-def reject(reason: str, message: str) -> "SessionRejected":
+def reject(reason: str, message: str, tenant: str = "-") -> "SessionRejected":
     """Count + build one admission refusal (the single place the
     rejection counter increments, so scheduler-level refusals — rule
-    mismatch, tag collision — meter identically to table-level ones)."""
+    mismatch, tag collision — meter identically to table-level ones).
+    ``tenant`` rides into the lifecycle journal when the caller knows
+    the accounting identity (the scheduler does; table-level geometry/
+    turns refusals pass the admit-time tenant)."""
     _ins.SESSIONS_REJECTED_TOTAL.labels(reason).inc()
+    _journal.record(
+        "session.reject", reason, tenant=tenant, message=message[:200]
+    )
     return SessionRejected(reason, message)
 
 
@@ -187,14 +194,19 @@ class SessionTable:
                 "geometry",
                 f"session board is {board.shape}, this batch serves "
                 f"{self.shape} (one geometry per batch)",
+                tenant=tenant,
             )
         if turns < 1:
-            raise reject("turns", f"turn budget must be >= 1, got {turns}")
+            raise reject(
+                "turns", f"turn budget must be >= 1, got {turns}",
+                tenant=tenant,
+            )
         with self._lock:
             if len(self._active) + len(self._pending) >= self.capacity:
                 raise reject(
                     "capacity",
                     f"session table full ({self.capacity} universes)",
+                    tenant=tenant,
                 )
             sess = Session(
                 self._next_sid, turns, 0, int(np.count_nonzero(board)),
@@ -204,6 +216,9 @@ class SessionTable:
             self._pending.append((sess, board.copy()))
             _ins.SESSIONS_ADMITTED_TOTAL.inc()
             _ins.SESSIONS_ACTIVE.set(len(self._active) + len(self._pending))
+        # journal outside the table lock: record() takes its own lock and
+        # must never extend this hot critical section
+        _journal.record("session.admit", str(sess.sid), turns=turns, tenant=tenant)
         return sess
 
     def cancel(self, sess: Session) -> None:
@@ -295,6 +310,7 @@ class SessionTable:
         events: List[tuple[Session, object]] = []
         finished: List[int] = []
         advanced: List[str] = []  # tenant per universe this chunk advanced
+        died: List[int] = []  # sids early-retired all-dead this chunk
         with self._lock:
             self._state = state
             for i, s in enumerate(active):
@@ -318,6 +334,7 @@ class SessionTable:
                         # already proved there is nothing left to compute
                         s.turns_done = s.turns
                         _ins.EARLY_EXIT_TOTAL.labels("dead").inc()
+                        died.append(s.sid)
                 if s.cancelled or s.remaining == 0:
                     finished.append(i)
             if advanced:
@@ -334,6 +351,16 @@ class SessionTable:
             m = len(advanced)
             _ins.SESSION_TURN_SECONDS.observe_n(dt_chunk / (k * m), k * m)
             _acct.ledger().record_chunk(advanced, k, dt_chunk)
+            # ONE journal record per chunk (not per universe): the commit
+            # the whole batch just made, with the dispatch route taken
+            _journal.record(
+                "chunk.commit", "sessions", k=k, universes=m,
+                dt_s=round(dt_chunk, 6),
+                route="fused" if hasattr(self._plane, "step_n_counts")
+                else "plain",
+            )
+        for sid in died:
+            _journal.record("early.exit", "dead", sid=sid)
 
         # retire + compact: ONE gather + ONE decode for every finishing
         # universe (a burst of equal budgets retiring together must not
@@ -368,6 +395,11 @@ class SessionTable:
                 _ins.SESSIONS_ACTIVE.set(left)
             for i in finished:
                 s = active[i]
+                if not s.cancelled:
+                    _journal.record(
+                        "session.final", str(s.sid), turn=s.turns_done,
+                        tenant=s.tenant,
+                    )
                 if s.on_event is not None and not s.cancelled:
                     from ..ops import alive_cells
 
@@ -407,6 +439,10 @@ class SessionTable:
             sessions += [s for s, _ in self._pending]
             self._active, self._pending, self._state = [], [], None
             _ins.SESSIONS_ACTIVE.set(0)
+        _journal.record(
+            "integrity.fail", "sessions.fail_all",
+            error_kind=type(exc).__name__, sessions=len(sessions),
+        )
         for s in sessions:
             s.error = exc
             s.done.set()

@@ -457,6 +457,76 @@ def _find_checkpoint(statuses) -> List[dict]:
     return out
 
 
+#: where _find_journal reads persisted segments from — module-level so
+#: tests (and the selfcheck's tmp dir) can point it elsewhere
+_JOURNAL_DIR = "out"
+
+
+def _find_journal(statuses) -> List[dict]:
+    """Journal-fed findings that SURVIVE restarts: unlike every other
+    heuristic (which reads live Status payloads), this one reads the
+    on-disk journal segments (obs/journal.py) — so a worker that flapped
+    three times YESTERDAY, under a broker that has since restarted and
+    forgotten, still surfaces. Two findings:
+
+    * repeat-loss/flap correlation: an address with repeated
+      lost->readmitted cycles across the whole persisted history;
+    * torn/corrupted records: crc-detected damage in the segments
+      themselves (a SIGKILL mid-append) — loud, never silent."""
+    from . import journal as _jn
+
+    events, problems = _jn.read_segments(_JOURNAL_DIR)
+    # fold in live in-memory tails when the polled processes ship them
+    # (events not yet flushed to a segment)
+    for label, payload in statuses.items():
+        jw = payload.get("journal")
+        if isinstance(jw, dict) and isinstance(jw.get("events"), list):
+            events.extend(e for e in jw["events"] if isinstance(e, dict))
+    out = []
+    losses: Dict[str, int] = {}
+    readmits: Dict[str, int] = {}
+    seen_ev = set()
+    for e in events:
+        key = (_jn.event_node(e), e.get("seq"), e.get("kind"))
+        if key in seen_ev:
+            continue  # an event in both a live tail and a segment
+        seen_ev.add(key)
+        if e.get("kind") == "worker.lost":
+            losses[e.get("name", "?")] = losses.get(e.get("name", "?"), 0) + 1
+        elif e.get("kind") == "worker.readmit":
+            readmits[e.get("name", "?")] = (
+                readmits.get(e.get("name", "?"), 0) + 1
+            )
+    for addr, n in sorted(losses.items(), key=lambda kv: -kv[1]):
+        if n < 2:
+            continue
+        back = readmits.get(addr, 0)
+        out.append(_finding(
+            "warn", 75.0 + min(15.0, 3.0 * n),
+            f"worker {addr} flapped: {n} losses / {back} readmissions "
+            "across the persisted journal history",
+            "repeat lost->readmitted cycles — a flapper taxes every turn "
+            "a deadline when admitted. This evidence comes from the "
+            "on-disk journal segments, so it survives broker restarts "
+            "that reset the live loss counters. Quarantine backoff is "
+            "escalating (worker.quarantine events); consider draining "
+            "the host.",
+            [f"journal: {n} worker.lost, {back} worker.readmit for {addr}"],
+            [addr], "journal",
+        ))
+    if problems:
+        out.append(_finding(
+            "warn", 55.0,
+            f"{len(problems)} damaged journal record(s)/segment(s) "
+            "detected (crc)",
+            "torn tails are expected after a SIGKILL mid-append — the "
+            "surviving records still reconstruct; repeated damage on a "
+            "LIVE process suggests disk trouble.",
+            problems[:8], [], "journal",
+        ))
+    return out
+
+
 _HEURISTICS = (
     _find_unreachable,
     _find_lost_workers,
@@ -468,6 +538,7 @@ _HEURISTICS = (
     _find_stall,
     _find_hbm,
     _find_checkpoint,
+    _find_journal,
 )
 
 
@@ -576,15 +647,23 @@ def write_report(
 
 
 # artifact globs a bundle collects out of the artifact directory — the
-# five files post-hoc triage used to mean hand-gathering. Newest-first
-# per pattern, capped so a long-lived out/ does not balloon the bundle.
-# The accounting ledger has no on-disk artifact of its own: it rides
-# each target's FULL Status payload, which the bundle writes verbatim.
+# files post-hoc triage used to mean hand-gathering. Newest-first per
+# pattern, capped (keep=N) so a long-lived out/ does not balloon the
+# bundle — EXCEPT the journal segments (keep=None: unlimited): the
+# lifecycle journal is the causal event history, and a rotated .g2
+# segment may hold exactly the loss/recovery sequence being triaged, so
+# EVERY generation of every process's journal is collected. Whatever a
+# cap drops is recorded in the manifest's ``dropped`` list — a bundle
+# must never look more complete than it is. The accounting ledger has
+# no on-disk artifact of its own: it rides each target's FULL Status
+# payload, which the bundle writes verbatim.
 _BUNDLE_GLOBS = (
     ("trace", "trace_*.json", 3),
     ("flight", "flight_*.jsonl", 3),
     ("report", "report_*.json", 3),
     ("doctor", "doctor_*.json", 3),
+    ("history", "history_*.json", 3),
+    ("journal", "journal_*.jsonl", None),
     ("analysis", "analysis.json", 1),
 )
 
@@ -621,13 +700,24 @@ def write_bundle(
     for label, payload in statuses.items():
         slug = label.replace(" ", "_").replace(":", "").replace("/", "_")
         _write(f"status_{slug}.json", payload, f"live Status poll: {label}")
+    dropped = []
     for kind, pattern, keep in _BUNDLE_GLOBS:
-        found = sorted(
-            out.glob(pattern), key=lambda p: p.stat().st_mtime, reverse=True
-        )
-        for src in found[:keep]:
-            if bdir in src.parents:
-                continue  # never re-collect this bundle's own files
+        found = [
+            p for p in sorted(
+                out.glob(pattern),
+                key=lambda p: p.stat().st_mtime, reverse=True,
+            )
+            if bdir not in p.parents  # never re-collect this bundle's own
+        ]
+        take = found if keep is None else found[:keep]
+        for src in found[len(take):]:
+            # capped out: the manifest NAMES what the bundle left behind,
+            # so an incomplete bundle never masquerades as the full record
+            dropped.append({
+                "file": src.name, "kind": kind,
+                "why": f"newest-{keep} cap for {kind} artifacts",
+            })
+        for src in take:
             dst = bdir / src.name
             try:
                 shutil.copy2(src, dst)
@@ -646,6 +736,7 @@ def write_bundle(
         "generated_unix": time.time(),
         "targets": sorted(statuses),
         "entries": entries,
+        "dropped": dropped,
     }
     (bdir / "manifest.json").write_text(
         json.dumps(manifest, indent=1, default=str)

@@ -519,6 +519,36 @@ STRIP_ROWS_SKIPPED_TOTAL = _R.counter(
     "frontier bound saved vs stepping the full strip.",
 )
 
+# -- lifecycle journal (obs/journal.py) ---------------------------------------
+
+JOURNAL_EVENTS_TOTAL = _R.counter(
+    "gol_journal_events_total",
+    "Lifecycle events appended to the durable journal (obs/journal.py), "
+    "by event kind (the journal's declared EVENT_KINDS table: "
+    "session.admit, chunk.commit, worker.lost, ckpt.write, ...). The "
+    "per-process tally of the durable, HLC-stamped history that "
+    "obs/history.py reconstructs cross-process timelines from.",
+    labelnames=("kind",),
+)
+JOURNAL_BYTES_TOTAL = _R.counter(
+    "gol_journal_bytes_total",
+    "Bytes the journal's buffered writer appended to on-disk segments "
+    "(crc-framed record lines, out/journal_<role>_<pid>*.jsonl).",
+)
+JOURNAL_ROTATIONS_TOTAL = _R.counter(
+    "gol_journal_rotations_total",
+    "Active journal segments retired down the generation chain when the "
+    "size cap (rotate_bytes) was reached — the bounded-retention knob "
+    "at work.",
+)
+JOURNAL_DROPS_TOTAL = _R.counter(
+    "gol_journal_drops_total",
+    "Journal records LOST to bounding — write-queue overflow on a "
+    "wedged disk, plus every record inside a segment retired past the "
+    "keep cap. Bounded retention may lose history; this meter is the "
+    "contract that it never loses it silently.",
+)
+
 # -- lock sanitizer (utils/locksan.py) ---------------------------------------
 
 LOCKSAN_VIOLATIONS_TOTAL = _R.counter(

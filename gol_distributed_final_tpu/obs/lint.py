@@ -97,6 +97,10 @@ _REQUIRED_SECTIONS = (
     # chunk driver + the worker strip paths): the K/VMEM trade-off
     # table, the routing knobs, and the launch-amortisation metric pair
     "## Fused stepping",
+    # the durable lifecycle journal contract (obs/journal.py +
+    # obs/history.py): the event-kind table, the HLC semantics, the
+    # retention knobs, and the history CLI examples
+    "## Journal & history",
 )
 
 # the wire data-plane metric families (rpc/protocol.py frames + the
@@ -336,6 +340,58 @@ def undocumented_fused_names(readme_path=None) -> List[str]:
     return sorted(n for n in _FUSED_DOC_NAMES if n not in section)
 
 
+# the durable-journal contract names (obs/journal.py writer +
+# obs/history.py merge CLI): the journal meters, the enablement/retention
+# knobs, and the incremental Status window field — these must be
+# documented in the README's "Journal & history" section specifically,
+# the operator contract postmortem reconstruction is read against
+_JOURNAL_DOC_NAMES = (
+    "gol_journal_events_total",
+    "gol_journal_bytes_total",
+    "gol_journal_rotations_total",
+    "gol_journal_drops_total",
+    "-journal",
+    "journal_since",
+)
+
+
+def undocumented_journal_names(readme_path=None) -> List[str]:
+    """Journal metric/knob names missing from the README's "Journal &
+    history" section specifically (the wire/device-table posture: a name
+    mentioned elsewhere in the file does not count as documented
+    here)."""
+    section = _readme_section(readme_path, "## Journal & history")
+    return sorted(n for n in _JOURNAL_DOC_NAMES if n not in section)
+
+
+def undeclared_journal_kinds(readme_path=None, package_root=None) -> List[str]:
+    """Registry drift between the journal's event-kind table and its
+    emit sites: every literal kind passed to ``journal.record(...)``
+    anywhere in the package must exist in ``obs/journal.EVENT_KINDS``
+    (and every event kind the README table documents comes FROM that
+    dict, so an undeclared emit is also an undocumented one). Scans
+    source text, not imports — an emit site behind an optional dep
+    still counts. ``readme_path`` is accepted (and ignored) so the
+    analysis wrapper can call every CHECKS entry uniformly."""
+    import re
+
+    from .journal import EVENT_KINDS
+
+    if package_root is None:
+        package_root = pathlib.Path(__file__).resolve().parent.parent
+    pat = re.compile(r"""\b_?journal\.record\(\s*["']([a-z._]+)["']""")
+    missing = set()
+    for path in sorted(pathlib.Path(package_root).rglob("*.py")):
+        try:
+            text = path.read_text()
+        except OSError:
+            continue
+        for kind in pat.findall(text):
+            if kind not in EVENT_KINDS:
+                missing.add(f"{kind} (emitted in {path.name})")
+    return sorted(missing)
+
+
 def missing_readme_sections(readme_path=None) -> List[str]:
     """Required operator-facing README sections that are absent."""
     if readme_path is None:
@@ -451,6 +507,23 @@ CHECKS = (
         "Fused stepping section:",
         "fused lint ok: every fused metric and knob is in the Fused "
         "stepping section",
+    ),
+    (
+        "lint-journal-metrics",
+        undocumented_journal_names,
+        "journal metric/knob names missing from README.md's Journal & "
+        "history section:",
+        "journal lint ok: every journal metric and knob is in the "
+        "Journal & history section",
+    ),
+    (
+        "lint-journal-kinds",
+        undeclared_journal_kinds,
+        "event kinds emitted via journal.record() but missing from "
+        "obs/journal.EVENT_KINDS (declare them there AND in the README "
+        "table):",
+        "journal-kind lint ok: every emitted event kind is declared in "
+        "EVENT_KINDS",
     ),
     (
         "lint-sections",

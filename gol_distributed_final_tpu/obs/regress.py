@@ -193,6 +193,7 @@ def compare_case(
         out = _apply_roofline_gate(old, new, out, threshold, 0.0)
         out = _apply_sparse_gates(old, new, out, threshold, 0.0)
         out = _apply_fused_gate(old, new, out, threshold)
+        out = _apply_journal_gate(old, new, out, threshold)
         return _apply_wire_bytes_gate(old, new, out, threshold)
     delta = new_us - old_us
     rel = delta / old_us
@@ -216,6 +217,7 @@ def compare_case(
     out = _apply_roofline_gate(old, new, out, threshold, noise_us / old_us)
     out = _apply_sparse_gates(old, new, out, threshold, noise_us / old_us)
     out = _apply_fused_gate(old, new, out, threshold)
+    out = _apply_journal_gate(old, new, out, threshold)
     return _apply_wire_bytes_gate(old, new, out, threshold)
 
 
@@ -311,6 +313,30 @@ def _apply_fused_gate(
         if rel > threshold:
             out["verdict"] = "REGRESSED"
             out["why"] = "dispatches per turn grew past threshold"
+    return out
+
+
+def _apply_journal_gate(
+    old: dict, new: dict, out: dict, threshold: float
+) -> dict:
+    """The journal-cost trajectory gate (ISSUE 16 satellite): the wire
+    bench's journal pair embeds ``journal_overhead_pct`` (journal-on vs
+    journal-off resident K=8). bench.py's own run-time gate holds each
+    round under 2% beyond its noise band; THIS gate is the cross-round
+    backstop — overhead creeping up by more than ``100 * threshold``
+    percentage points between rounds (default 5 points) is REGRESSED
+    even if a loosened or noisy per-round band let it through, so a
+    hot-path record() regression cannot ratchet in across rounds."""
+    old_j, new_j = old.get("journal_overhead_pct"), new.get("journal_overhead_pct")
+    if old_j is not None and new_j is not None:
+        out["old_journal_overhead_pct"] = old_j
+        out["new_journal_overhead_pct"] = new_j
+        out["journal_overhead_delta_pts"] = round(new_j - old_j, 2)
+        if new_j - old_j > 100.0 * threshold:
+            out["verdict"] = "REGRESSED"
+            out["why"] = (
+                "journal overhead grew past the cross-round threshold"
+            )
     return out
 
 

@@ -23,13 +23,15 @@ from typing import Optional
 
 from . import accounting, flight, metrics, timeline, tracing
 from . import critical as _critical
+from . import journal as _journal
 from . import perf as _perf
 
 SCHEMA = "gol-run-report/1"
 
 
 def status_payload(
-    timeline_since: int = 0, accounting_since: int = 0, **extra
+    timeline_since: int = 0, accounting_since: int = 0,
+    journal_since: int = 0, **extra
 ) -> dict:
     """The ``Status`` verb's reply body: registry snapshot + identity.
 
@@ -85,6 +87,12 @@ def status_payload(
         # past the caller's accounting_since seq, bounded at top-K
         # tenants + the 'other' bucket either way
         payload["accounting"] = ledger.window(since=accounting_since)
+    jw = _journal.window(since=journal_since)
+    if jw is not None:
+        # the lifecycle journal's incremental tail (obs/journal.py) —
+        # the live half of `python -m ..obs.history` and the watch
+        # JOURNAL panel; only events past the caller's journal_since
+        payload["journal"] = jw
     payload.update(extra)
     return payload
 
@@ -207,6 +215,12 @@ def write_run_report(
         # who spent this run's capacity: the bounded per-tenant ledger
         # rides the final artifact beside the timeline verdict
         report["accounting"] = ledger.window()
+    js = _journal.summary()
+    if js is not None:
+        # what HAPPENED this run: the lifecycle journal's by-kind totals
+        # and drop/rotation accounting (the segments on disk hold the
+        # full causally-stamped history)
+        report["journal"] = js
     decomp = _perf.decomposition_summary(snap)
     if decomp:
         # WHERE the wall went: the dispatch-wall decomposition breakdown

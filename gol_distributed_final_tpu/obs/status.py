@@ -80,6 +80,7 @@ def fetch_status(
     worker: bool = False,
     timeout: float = 10.0,
     timeline_since: int = 0,
+    journal_since: int = 0,
 ) -> dict:
     """One Status round-trip against a broker (default) or worker.
 
@@ -87,6 +88,8 @@ def fetch_status(
     (``payload["timeline"]["seq"]``) so a ``-timeline`` server ships
     only NEWER samples — the incremental-window contract; 0 asks for the
     full ring, and a pre-timeline server ignores the field entirely.
+    ``journal_since`` is the lifecycle journal's twin (obs/journal.py):
+    a ``-journal`` server ships only events past this seq.
 
     Raises ``StatusUnavailable`` (with a mode-specific message, see
     ``extract_status``) instead of returning an empty dict, so callers
@@ -101,7 +104,10 @@ def fetch_status(
         # wedged server must fail this poller, never hang it
         res = client.call(
             Methods.WORKER_STATUS if worker else Methods.STATUS,
-            Request(timeline_since=timeline_since),
+            Request(
+                timeline_since=timeline_since,
+                journal_since=journal_since,
+            ),
             timeout=timeout,
         )
     finally:

@@ -539,6 +539,36 @@ def _flight_lines(payload: dict, tail: int = 6) -> List[str]:
     return out
 
 
+def _journal_lines(payload: dict, tail: int = 8) -> List[str]:
+    """The lifecycle-journal tail (obs/journal.py window): the last few
+    HLC-stamped events this process persisted — admissions, losses,
+    recoveries, checkpoints — plus the drop counter, which must be loud
+    on a dashboard (a dropping journal is an incomplete postmortem)."""
+    jw = payload.get("journal")
+    if not isinstance(jw, dict):
+        return []
+    events = jw.get("events") or []
+    dropped = jw.get("dropped", 0)
+    head = f"JOURNAL (seq {jw.get('seq', '?')}"
+    if dropped:
+        head += f", {dropped} DROPPED"
+    head += ")"
+    out = [head]
+    if not events:
+        out.append("  no new events this window")
+        return out
+    now = time.time()
+    for ev in events[-tail:]:
+        age = now - (ev.get("t_unix") or now)
+        args = ev.get("args") or {}
+        detail = " ".join(f"{k}={v}" for k, v in list(args.items())[:4])
+        out.append(
+            f"  -{age:6.1f}s  {ev.get('kind', '?'):<16} "
+            f"{ev.get('name', '?')} {detail}".rstrip()
+        )
+    return out
+
+
 def render_status(
     label: str,
     payload: dict,
@@ -571,6 +601,7 @@ def render_status(
         _compile_lines(snap),
         _hbm_lines(snap),
         _flight_lines(payload),
+        _journal_lines(payload),
     ]
     lines = [head]
     for sec in sections:
@@ -591,6 +622,8 @@ class Watcher:
         # addr -> last timeline seq received: echoed back so a -timeline
         # server ships incremental windows instead of the whole ring
         self._tl_seq: Dict[str, int] = {}
+        # addr -> last journal seq received (the journal twin)
+        self._jr_seq: Dict[str, int] = {}
 
     def _turns_rate(self, addr: str, payload: dict) -> Optional[float]:
         now = time.monotonic()
@@ -619,10 +652,14 @@ class Watcher:
                 payload = fetch_status(
                     addr, worker=is_worker, timeout=self.timeout,
                     timeline_since=self._tl_seq.get(addr, 0),
+                    journal_since=self._jr_seq.get(addr, 0),
                 )
                 seq = (payload.get("timeline") or {}).get("seq")
                 if isinstance(seq, int):
                     self._tl_seq[addr] = seq
+                jseq = (payload.get("journal") or {}).get("seq")
+                if isinstance(jseq, int):
+                    self._jr_seq[addr] = jseq
             except StatusUnavailable as exc:
                 blocks.append(f"== {kind} {addr}: no status — {exc}")
                 continue

@@ -37,6 +37,7 @@ from ..engine.engine import Engine, RunResult, Snapshot
 from ..obs import critical as _critical
 from ..obs import flight as _flight
 from ..obs import instruments as _ins
+from ..obs import journal as _journal
 from ..obs import metrics as _metrics
 from ..obs import perf as _perf
 from ..obs import tracing as _tracing
@@ -556,6 +557,10 @@ class WorkersBackend:
                             "%d worker(s) lost mid-run at turn %d; "
                             "resplitting over %d",
                             len(dead), self._turn, left,
+                        )
+                        _journal.record(
+                            "recovery.resplit", "scatter", turn=self._turn,
+                            lost=len(dead), remaining=left,
                         )
 
                     new_world = np.concatenate(strips, axis=0)
@@ -1168,6 +1173,10 @@ class WorkersBackend:
                             "recovering over %d",
                             len(set(dead)), turn0, left,
                         )
+                        _journal.record(
+                            "recovery.resplit", "resident", turn=turn0,
+                            lost=len(set(dead)), remaining=left,
+                        )
                         self._resident_recover(plan, pool, tp)
                         plan = None
                         continue
@@ -1263,6 +1272,7 @@ class WorkersBackend:
         with self._lock:
             addr = self._client_addr.get(id(plan.active[i]), "<local>")
         _flight.record("integrity.fail", addr, check=kind)
+        _journal.record("integrity.fail", addr, check=kind, detail=detail[:200])
         logger.error(
             "INTEGRITY violation (%s) from worker %s: %s", kind, addr, detail
         )
@@ -1365,6 +1375,7 @@ class WorkersBackend:
         # transport — the loss itself is logged + metered just below
         except Exception:
             pass
+        backoff = 0.0
         with self._lock:
             if client in self.clients:
                 self.clients.remove(client)
@@ -1383,6 +1394,17 @@ class WorkersBackend:
                 self._lost[addr] = time.monotonic() + backoff
         _ins.WORKER_LOST_TOTAL.inc()
         _flight.record("worker.lost", addr or "<local>", reason=reason)
+        _journal.record(
+            "worker.lost", addr or "<local>", reason=reason,
+            backoff_s=round(backoff, 2),
+        )
+        if addr is not None and backoff > self._probe_interval:
+            # an escalated backoff IS the quarantine decision — journal it
+            # as its own lifecycle event so history/doctor can correlate
+            # repeat losses with the flap window
+            _journal.record(
+                "worker.quarantine", addr, backoff_s=round(backoff, 2)
+            )
         logger.warning("worker %s lost (%s)", addr or "<local>", reason)
 
     def _probe_loop(self) -> None:
@@ -1449,6 +1471,7 @@ class WorkersBackend:
                     connected = len(self.clients)
                 _ins.WORKER_READMITTED_TOTAL.inc()
                 _flight.record("worker.readmit", addr)
+                _journal.record("worker.readmit", addr, connected=connected)
                 logger.info(
                     "worker %s readmitted; %d connected", addr, connected
                 )
@@ -1547,6 +1570,10 @@ class WorkersBackend:
             return
         _ins.AUTO_CHECKPOINT_TOTAL.inc()
         _flight.record("ckpt.auto", str(p), turn=turn, delta=bool(delta))
+        _journal.record(
+            "ckpt.write", "broker", turn=turn, delta=bool(delta),
+            path=str(p),
+        )
 
     def worker_health(self) -> list[dict]:
         """Per-address roster health for the Status payload (rendered as
@@ -1826,9 +1853,13 @@ class SessionScheduler:
                         "rule",
                         f"this batch serves {self._table.rule.rulestring}, "
                         f"not {rule.rulestring} (one rule per batch)",
+                        tenant=tenant,
                     )
                 if tag and tag in self._tags:
-                    raise reject("tag", f"session tag {tag} already in use")
+                    raise reject(
+                        "tag", f"session tag {tag} already in use",
+                        tenant=tenant,
+                    )
                 # geometry/capacity/turns admission happens in the table
                 sess = self._table.admit(world, req.turns, tenant=tenant)
                 if tag:
@@ -2021,6 +2052,7 @@ class BrokerService:
             req.rulestring = rule.rulestring
         logger.info("Run reattached to -resume checkpoint at turn %d", turn)
         _flight.record("ckpt.resume", "broker", turn=turn)
+        _journal.record("ckpt.replay", "broker", turn=turn)
 
     def run(self, req: Request) -> Response:
         req = _require_request(req)
@@ -2045,7 +2077,14 @@ class BrokerService:
                 f"world shape {req.world.shape} does not match params "
                 f"{req.image_width}x{req.image_height}"
             )
+        _journal.record(
+            "run.start", "broker", turns=int(req.turns),
+            initial_turn=initial_turn, resumed=resumed,
+        )
         result = self.backend.run(req)
+        _journal.record(
+            "run.end", "broker", turn=int(result.turns_completed)
+        )
         if resumed and result.turns_completed > getattr(req, "initial_turn", 0):
             # consumed only once the run actually PROGRESSED past the
             # checkpoint: a Run that fails after substitution (workers
@@ -2135,10 +2174,13 @@ class BrokerService:
         # accounting_since: the tenant-ledger twin of timeline_since
         # (getattr: an older client's pickle lacks it; 0 = full ledger)
         asince = getattr(req, "accounting_since", 0)
+        # journal_since: the lifecycle-journal twin (obs/journal.py)
+        jsince = getattr(req, "journal_since", 0)
         payload = status_payload(
             role="broker", backend=type(self.backend).__name__,
             timeline_since=since if isinstance(since, int) else 0,
             accounting_since=asince if isinstance(asince, int) else 0,
+            journal_since=jsince if isinstance(jsince, int) else 0,
         )
         health = getattr(self.backend, "worker_health", None)
         if callable(health):
@@ -2361,6 +2403,15 @@ def main(argv=None) -> None:
              "via Request.trace_ctx and ship back in Status replies",
     )
     parser.add_argument(
+        "-journal", nargs="?", const="out", default=None, metavar="DIR",
+        help="enable the durable lifecycle journal (obs/journal.py): "
+             "HLC-stamped lifecycle events (admissions, chunk commits, "
+             "losses, recoveries, checkpoints, ...) append to "
+             "DIR/journal_broker_<pid>.jsonl (default out/), crc-framed "
+             "and size-rotated; read back with "
+             "python -m ...obs.history after the fact",
+    )
+    parser.add_argument(
         "-canary", nargs="?", const=5.0, default=None, type=float,
         metavar="SECS",
         help="run the blackbox canary prober (obs/canary.py) in-process "
@@ -2399,6 +2450,8 @@ def main(argv=None) -> None:
         tracing.enable()
         tracing.set_process_name("broker")
         flight.enable()
+    if args.journal is not None:
+        _journal.enable(out_dir=args.journal, role="broker")
     _integrity.set_enabled(args.integrity == "on")
     if args.ckpt_keep < 1:
         parser.error(f"-ckpt-keep must be >= 1, got {args.ckpt_keep}")
@@ -2519,9 +2572,18 @@ def main(argv=None) -> None:
         canary.start()
     try:
         service.quit_event.wait()
+    except BaseException as exc:
+        # crash hook (the engine-path posture, engine/engine.py): an
+        # unhandled exception or KeyboardInterrupt in the entry point
+        # leaves the flight ring AND the journal tail on disk before
+        # propagating — the postmortem evidence for a dead broker
+        _flight.dump_on_crash(exc)
+        _journal.flush_on_crash(exc)
+        raise
     finally:
         if canary is not None:
             canary.stop()
+        _journal.disable()  # flush + close the segment cleanly
 
 
 if __name__ == "__main__":

@@ -17,6 +17,7 @@ import time
 
 from ..obs import flight as _flight
 from ..obs import instruments as _ins
+from ..obs import journal as _journal
 from ..obs import metrics as _metrics
 from ..obs import tracing as _tracing
 from ..utils import locksan as _locksan
@@ -293,6 +294,13 @@ class RpcClient:
         if closed.is_set():
             self._maybe_reconnect()  # raises unless a fresh transport is up
             sock, closed = self._transport
+        # hybrid-logical-clock stamp (obs/journal.py): every outbound
+        # request carries this process's causal position, so the server's
+        # journal events order after ours. Unconditional — the clock is a
+        # few integer compares, and causality must not depend on which
+        # side happened to enable its journal.
+        if isinstance(request, Request):
+            request.hlc = _journal.stamp()
         call_id = next(self._ids)
         slot = {"event": threading.Event(), "reply": None}
         with self._pending_lock:
@@ -387,7 +395,11 @@ class RpcClient:
         # non-error reply in every protocol version — a missing key is a
         # malformed envelope that must fail loudly, not default to None
         # (None is a legitimate result value)
-        return reply["result"]
+        result = reply["result"]
+        # fold the server's reply stamp into our clock: events we record
+        # after this call are causally after everything it journalled
+        _journal.observe(getattr(result, "hlc", None))
+        return result
 
     def close(self) -> None:
         # _user_closed first, then the lock: a reconnect attempt mid-dial

@@ -133,6 +133,19 @@ class Request:
     # the full strip. -1 (and a version-skewed older broker's pickle,
     # via getattr) = full fetch, the pre-delta wire behavior.
     delta_base_turn: int = -1
+    # extension: the caller's hybrid-logical-clock stamp (obs/journal.py
+    # — a plain [physical_ms, logical, node] list, so it crosses the
+    # restricted unpickler). The server merges it into its process clock
+    # before dispatching, so every journal event the handler records is
+    # causally ordered after the client-side events that caused the
+    # call. getattr-read: a skewed peer's pickle means "no causality
+    # hint", never an error.
+    hlc: Optional[list] = None
+    # extension: incremental journal-tail windows (obs/journal.py) —
+    # timeline_since's twin for the lifecycle journal: a Status caller
+    # echoes the last journal ``seq`` it received and the server ships
+    # only newer tail events (obs/history.py rides it).
+    journal_since: int = 0
 
 
 @dataclasses.dataclass
@@ -186,6 +199,11 @@ class Response:
     # version-skewed or pre-delta peer's pickle — skew degrades to
     # "full frames", never an AttributeError.
     dirty: Optional[np.ndarray] = None
+    # extension: the server's hybrid-logical-clock stamp (obs/journal.py)
+    # — Request.hlc's reply-side twin: the client merges it into its
+    # process clock, so client-side events after the reply are causally
+    # ordered after everything the handler journalled. Same skew posture.
+    hlc: Optional[list] = None
 
 
 # -- deserialisation allowlist ----------------------------------------------

@@ -15,6 +15,7 @@ import traceback
 
 from ..obs import flight as _flight
 from ..obs import instruments as _ins
+from ..obs import journal as _journal
 from ..obs import metrics as _metrics
 from ..obs import tracing as _tracing
 from ..utils import locksan as _locksan
@@ -131,6 +132,12 @@ class RpcServer:
             # AttributeError). The handler runs on this thread, so engine/
             # backend spans inside it parent here via the thread-local
             # stack, joining the caller's trace across the process boundary.
+            # fold the caller's hybrid-logical-clock stamp into this
+            # process's clock BEFORE the handler runs (obs/journal.py):
+            # every journal event the handler records is then causally
+            # ordered after the client-side events that caused the call.
+            # Same skew posture as trace_ctx: absent field, no hint.
+            _journal.observe(getattr(request, "hlc", None))
             ctx = getattr(request, "trace_ctx", None)
             span = _tracing.start_span(
                 _tracing.SPAN_RPC_SERVER,
@@ -169,6 +176,10 @@ class RpcServer:
                         # reply-side context: lets the client link its
                         # round-trip span to this handler span
                         result.trace_ctx = span.ctx()
+                    if isinstance(result, Response):
+                        # reply-side clock stamp: the client merges it,
+                        # so its later events order after this handler's
+                        result.hlc = _journal.stamp()
                     reply = {"id": call_id, "result": result}
                 except Exception as e:  # error crosses the wire, like net/rpc
                     # structured: the exception CLASS and raise site cross

@@ -25,6 +25,7 @@ import time
 import numpy as np
 
 from ..obs import instruments as _ins
+from ..obs import journal as _journal
 from ..utils import locksan as _locksan
 from . import faults as _faults
 from . import integrity as _integrity
@@ -367,6 +368,10 @@ class WorkerService:
         # StripStep landing between release and reply must not make this
         # seed acknowledgment claim the stepped turn (analysis/locks.py
         # caught the original unlocked read)
+        _journal.record(
+            "run.start", "worker", turn=turn, index=int(req.worker),
+            rows=int(strip.shape[0]),
+        )
         return Response(worker=req.worker, turns_completed=turn)
 
     def strip_step(self, req: Request) -> Response:
@@ -455,15 +460,23 @@ class WorkerService:
                     "attest_top": att_top,
                     "attest_bottom": att_bottom,
                 }
-            return Response(
-                worker=req.worker,
-                turns_completed=self._strip_turn,
-                edges=edges,
-                counts=counts,
-                digests=digests,
-                dirty=dirty,
-                service_seconds=time.monotonic() - t0,
-            )
+            turn_done = self._strip_turn
+        # journal outside the strip lock (one record per K-turn batch):
+        # this worker's half of the chunk the broker is about to commit
+        _journal.record(
+            "chunk.commit", "worker", k=k, turn=turn_done,
+            alive=int(counts[-1]) if counts else 0,
+            route="attested" if check else "plain",
+        )
+        return Response(
+            worker=req.worker,
+            turns_completed=turn_done,
+            edges=edges,
+            counts=counts,
+            digests=digests,
+            dirty=dirty,
+            service_seconds=time.monotonic() - t0,
+        )
 
     def strip_fetch(self, req: Request) -> Response:
         """Read the resident strip + its turn back out (full re-syncs,
@@ -530,9 +543,11 @@ class WorkerService:
         from ..obs.report import status_payload
 
         since = getattr(req, "timeline_since", 0)
+        jsince = getattr(req, "journal_since", 0)
         return Response(status=status_payload(
             role="worker",
             timeline_since=since if isinstance(since, int) else 0,
+            journal_since=jsince if isinstance(jsince, int) else 0,
         ))
 
     def _shutdown(self):
@@ -586,8 +601,18 @@ def main(argv=None) -> None:
              "advertising and computing — an off worker is undefended "
              "against silent corruption",
     )
+    parser.add_argument(
+        "-journal", nargs="?", const="out", default=None, metavar="DIR",
+        help="enable the durable lifecycle journal (obs/journal.py): "
+             "HLC-stamped lifecycle events append to "
+             "DIR/journal_worker_<pid>.jsonl (default out/), crc-framed "
+             "and size-rotated; merged cross-process by "
+             "python -m ...obs.history",
+    )
     args = parser.parse_args(argv)
     _integrity.set_enabled(args.integrity == "on")
+    if args.journal is not None:
+        _journal.enable(out_dir=args.journal, role="worker")
     if args.metrics:
         from ..obs import metrics
 
@@ -609,7 +634,20 @@ def main(argv=None) -> None:
         tracing.set_process_name(f"worker:{server.port}")
         flight.enable()
     print(f"worker listening on :{server.port}", flush=True)
-    service.quit_event.wait()
+    try:
+        service.quit_event.wait()
+    except BaseException as exc:
+        # crash hook (the engine-path posture, engine/engine.py): leave
+        # the flight ring + journal tail on disk before propagating —
+        # the postmortem evidence for a dead worker (satellite of the
+        # broker __main__ hook; both were engine-only before)
+        from ..obs import flight as _flight
+
+        _flight.dump_on_crash(exc)
+        _journal.flush_on_crash(exc)
+        raise
+    finally:
+        _journal.disable()  # flush + close the segment cleanly
 
 
 if __name__ == "__main__":
