@@ -224,6 +224,7 @@ def _bench_wire_modes(extra: dict) -> int:
     from gol_distributed_final_tpu.obs import journal as obs_journal
     from gol_distributed_final_tpu.obs import metrics as obs_metrics
     from gol_distributed_final_tpu.obs import perf as obs_perf
+    from gol_distributed_final_tpu.obs import profiler as obs_profiler
     from gol_distributed_final_tpu.obs import timeline as obs_timeline
     from gol_distributed_final_tpu.rpc import integrity as _integrity
     from gol_distributed_final_tpu.rpc import worker as rpc_worker
@@ -244,33 +245,41 @@ def _bench_wire_modes(extra: dict) -> int:
     want100 = None  # cross-mode parity reference (100 turns)
     jdir = tempfile.mkdtemp(prefix="gol_bench_journal_")
     try:
-        for wire, k, key, n_lo, n_hi, check, timeline, attribution, journal in (
-            ("full", 1, "c7_wire_full", 30, 230, True, False, True, False),
-            ("haloed", 1, "c7_wire_haloed", 30, 230, True, False, True, False),
+        for wire, k, key, n_lo, n_hi, check, timeline, attribution, journal, profile in (
+            ("full", 1, "c7_wire_full", 30, 230, True, False, True, False, False),
+            ("haloed", 1, "c7_wire_haloed", 30, 230, True, False, True, False, False),
             # resident turns are much cheaper per RPC: wider endpoints so
             # the marginal work still dominates loopback timing noise
-            ("resident", 1, "c7_wire_resident_k1", 100, 1100, True, False, True, False),
-            ("resident", 8, "c7_wire_resident_k8", 100, 1100, True, False, True, False),
+            ("resident", 1, "c7_wire_resident_k1", 100, 1100, True, False, True, False, False),
+            ("resident", 8, "c7_wire_resident_k8", 100, 1100, True, False, True, False, False),
             # the same case UNDEFENDED (-integrity off, both sides): the
             # checked case above pays the in-header frame crcs + adler32
             # attestations, so the pair prices the integrity layer — the
             # overhead gate below holds it under 3% of resident turn cost
-            ("resident", 8, "c7_wire_resident_k8_nock", 100, 1100, False, False, True, False),
+            ("resident", 8, "c7_wire_resident_k8_nock", 100, 1100, False, False, True, False, False),
             # the same case with the -timeline sampler ON (1 s cadence,
             # the serving default): prices the always-on history + SLO
             # evaluation; the overhead gate below holds it under 2%
-            ("resident", 8, "c7_wire_resident_k8_timeline", 100, 1100, True, True, True, False),
+            ("resident", 8, "c7_wire_resident_k8_timeline", 100, 1100, True, True, True, False, False),
             # the same case with the dispatch-wall decomposition + the
             # critical-path attribution OFF (obs/perf.set_attribution):
             # the on-vs-off pair prices the WHERE-TIME-GOES layer; the
             # overhead gate below holds it under 2%
-            ("resident", 8, "c7_wire_resident_k8_noattr", 100, 1100, True, False, False, False),
+            ("resident", 8, "c7_wire_resident_k8_noattr", 100, 1100, True, False, False, False, False),
             # the same case with the durable lifecycle journal ON
             # (obs/journal.py: hot-path record() calls + the buffered
             # segment writer, flushing to a throwaway dir): prices the
             # "-journal in production" story; the overhead gate below
             # holds it under 2% of resident turn cost
-            ("resident", 8, "c7_wire_resident_k8_journal", 100, 1100, True, False, True, True),
+            ("resident", 8, "c7_wire_resident_k8_journal", 100, 1100, True, False, True, True, False),
+            # the same case with the continuous sampling profiler ON
+            # (obs/profiler.py: 10 ms wall-clock stack sampling + GC
+            # pause metering, adaptive backoff armed): prices the
+            # "-profile in production" story; the overhead gate below
+            # holds it under 2% of resident turn cost, and the case
+            # embeds the sampled hot-frame table for regress's
+            # cross-round top-mover gate
+            ("resident", 8, "c7_wire_resident_k8_profile", 100, 1100, True, False, True, False, True),
         ):
             _integrity.set_enabled(check)
             obs_perf.set_attribution(attribution)
@@ -278,6 +287,8 @@ def _bench_wire_modes(extra: dict) -> int:
                 obs_timeline.enable(period=1.0)
             if journal:
                 obs_journal.enable(out_dir=jdir, role="bench")
+            if profile:
+                obs_profiler.enable(period_ms=10.0, out_dir=jdir, tag="bench")
             backend = WorkersBackend(addrs, wire=wire, halo_depth=k)
             try:
                 def evolve(n, backend=backend):
@@ -306,12 +317,38 @@ def _bench_wire_modes(extra: dict) -> int:
                     halo_depth=k,
                     wire_bytes_per_turn=round(per_turn_bytes, 1),
                 )
+                if profile:
+                    # embed the sampled top busy frames BEFORE disable
+                    # (disable drops the trie): regress's cross-round
+                    # top-mover gate reads this table out of BENCH_r*.json
+                    ps = obs_profiler.summary() or {}
+                    frames = [
+                        r for r in ps.get("frames") or []
+                        if not obs_profiler.is_idle_frame(
+                            r.get("func", ""), r.get("file", "")
+                        )
+                    ]
+                    busy_total = sum(r.get("self") or 0 for r in frames)
+                    extra[key]["profile_hot"] = [
+                        {
+                            "frame": obs_profiler.frame_name(
+                                r["func"], r["file"], r["line"]
+                            ),
+                            "self_share": round(
+                                (r.get("self") or 0) / busy_total, 3
+                            ),
+                        }
+                        for r in frames[:5]
+                    ] if busy_total else []
+                    extra[key]["profile_samples"] = ps.get("stacks", 0)
             finally:
                 backend.close()
                 if timeline:
                     obs_timeline.disable()
                 if journal:
                     obs_journal.disable()
+                if profile:
+                    obs_profiler.disable()
         print("parity wire modes ok (100 turns, cross-mode)", file=sys.stderr)
         hal = extra["c7_wire_haloed"]["wire_bytes_per_turn"]
         res8 = extra["c7_wire_resident_k8"]["wire_bytes_per_turn"]
@@ -439,11 +476,41 @@ def _bench_wire_modes(extra: dict) -> int:
             f"{2 * jn_noise_us:.2f} us)",
             file=sys.stderr,
         )
+        # profiler overhead gate: profiler-on vs profiler-off resident
+        # K=8, the same noise-band posture — 10 ms wall-clock stack
+        # sampling (plus GC pause metering) must stay under 2% of
+        # resident turn cost or the "continuous profiling in
+        # production" story dies here; the adaptive backoff exists
+        # precisely to make this gate holdable on slow hosts
+        pr = extra["c7_wire_resident_k8_profile"]
+        pt_pr = pr["per_turn_us"]
+        pr_noise_us = sum(
+            c["spread_s"] / (c["n_hi"] - c["n_lo"]) * 1e6 for c in (ck, pr)
+        )
+        profile_overhead_pct = (pt_pr - pt_ck) / pt_ck * 100.0
+        pr["profile_overhead_pct"] = round(profile_overhead_pct, 2)
+        if pt_pr - pt_ck > 0.02 * pt_ck + 2 * pr_noise_us:
+            print(
+                f"PROFILER OVERHEAD GATE FAILURE: profiler-on resident k8 "
+                f"{pt_pr:.2f} us/turn vs off {pt_ck:.2f} "
+                f"({profile_overhead_pct:+.1f}%) exceeds 2% beyond the "
+                f"{pr_noise_us:.2f} us noise band",
+                file=sys.stderr,
+            )
+            return 1
+        print(
+            f"profiler overhead ok: profiler on {pt_pr:.2f} us/turn vs "
+            f"off {pt_ck:.2f} ({profile_overhead_pct:+.1f}%, band "
+            f"{2 * pr_noise_us:.2f} us; {pr.get('profile_samples', 0)} "
+            f"stacks sampled)",
+            file=sys.stderr,
+        )
     finally:
         _integrity.set_enabled(True)
         obs_perf.set_attribution(True)
         obs_timeline.disable()
         obs_journal.disable()
+        obs_profiler.disable()
         shutil.rmtree(jdir, ignore_errors=True)
         for server, _service in servers:
             server.stop()

@@ -132,6 +132,16 @@ def main(argv=None) -> int:
              "in the run report, and counter tracks join the -trace "
              "Chrome export; implies -metrics",
     )
+    parser.add_argument(
+        "-profile", nargs="?", const=10.0, default=None, type=float,
+        metavar="MS",
+        help="enable the continuous sampling profiler (obs/profiler.py) "
+             "at this cadence (default 10 ms, adaptive backoff): the "
+             "run report embeds the hot-frame summary and collapsed-"
+             "stack + speedscope artifacts land in out/ at session end "
+             "(render/diff with python -m ...obs.flame); implies "
+             "-metrics",
+    )
     args = parser.parse_args(argv)
     if args.metrics or args.report:
         # before any instrumented path runs, so the report sees the whole
@@ -153,6 +163,14 @@ def main(argv=None) -> int:
         tracing.enable()
         tracing.set_process_name("controller")
         flight.enable()
+    if args.profile is not None:
+        if args.profile <= 0:
+            parser.error(f"-profile MS must be > 0, got {args.profile}")
+        from .obs import profiler as _profiler
+
+        _profiler.enable(
+            period_ms=args.profile, tag="controller"
+        )  # implies metrics.enable()
     if args.halo_depth < 0:
         parser.error(
             f"-halo-depth must be >= 1 (or 0 for the broker's default), "
@@ -235,7 +253,19 @@ def main(argv=None) -> int:
             run(params, events, keypresses, broker=broker, rule=rule,
                 emit_flips=emit_flips, resume_from=resume,
                 halo_depth=args.halo_depth, report=args.report)
+    except BaseException as exc:
+        if args.profile is not None:
+            from .obs import profiler as _profiler
+
+            # crash-path artifacts (the broker/worker hook's controller
+            # twin): the profile of the session that died, on disk
+            _profiler.flush_on_crash(exc)
+        raise
     finally:
+        if args.profile is not None:
+            from .obs import profiler as _profiler
+
+            _profiler.shutdown()  # run-end artifacts + gc unhook
         consumer.join()
         restore_tty()
     return 0

@@ -24,6 +24,16 @@ Two resource-lifecycle contracts:
    thread leak multiplied by its worker count — the RpcClient-pool
    class of bug: the broker's scatter pool outliving its run wedges
    shutdown exactly like one un-joined thread, times ``pool_size``.
+
+4. **GC callbacks.** A file that registers a collector hook
+   (``gc.callbacks.append(...)``) must also unregister one
+   (``gc.callbacks.remove(...)``) somewhere in the same file. The
+   process-global ``gc.callbacks`` list outlives every object: an
+   append with no paired remove keeps the callback — and everything
+   its closure holds — alive for the life of the interpreter, and
+   fires it on collections long after the owner was "closed" (the
+   profiler's GC pause meter is exactly this shape; obs/profiler.py
+   pairs install_gc with remove_gc).
 """
 
 from __future__ import annotations
@@ -87,18 +97,21 @@ class HygieneChecker(Checker):
     id = "hygiene"
     description = (
         "threads are daemon=True or joined in-file; executors are "
-        "context-managed or shut down in-file; broad except handlers "
+        "context-managed or shut down in-file; gc.callbacks.append is "
+        "paired with a remove in-file; broad except handlers "
         "log/flight-record/raise/return instead of silently swallowing"
     )
     bug_class = (
-        "leaked threads/pools wedging process shutdown; failures "
-        "vanishing with no log, flight event, or propagation"
+        "leaked threads/pools wedging process shutdown; gc callbacks "
+        "registered forever; failures vanishing with no log, flight "
+        "event, or propagation"
     )
 
     def check_file(self, tree, source, relpath) -> Iterable[Finding]:
         findings: List[Finding] = []
         self._check_threads(tree, relpath, findings)
         self._check_executors(tree, relpath, findings)
+        self._check_gc_callbacks(tree, relpath, findings)
         self._check_excepts(tree, relpath, findings)
         return findings
 
@@ -255,6 +268,37 @@ class HygieneChecker(Checker):
                 f"threads wedging process shutdown",
             ))
 
+    # -- gc callbacks --------------------------------------------------------
+
+    def _check_gc_callbacks(self, tree, relpath, findings) -> None:
+        """Registration pairing on the process-global collector-hook
+        list: every ``gc.callbacks.append(...)`` needs SOME
+        ``gc.callbacks.remove(...)`` in the same file. File-level (not
+        owning-scope) on purpose: install/uninstall conventionally live
+        in different functions of one module (install_gc/remove_gc), and
+        the global list means a remove anywhere genuinely discharges the
+        leak — unlike a thread join, which must name its thread."""
+        appends: List[ast.Call] = []
+        has_remove = False
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            kind = _gc_callbacks_op(node)
+            if kind == "append":
+                appends.append(node)
+            elif kind == "remove":
+                has_remove = True
+        if has_remove:
+            return
+        for node in appends:
+            findings.append(Finding(
+                self.id, relpath, node.lineno,
+                "gc.callbacks.append without any gc.callbacks.remove in "
+                "this file — the process-global hook list keeps the "
+                "callback (and its closure) alive and firing on every "
+                "collection after the owner is closed",
+            ))
+
     # -- excepts -------------------------------------------------------------
 
     def _check_excepts(self, tree, relpath, findings) -> None:
@@ -290,6 +334,22 @@ class HygieneChecker(Checker):
                     f"flight-record it, narrow the type, or justify the "
                     f"suppression",
                 ))
+
+
+def _gc_callbacks_op(node: ast.Call) -> str:
+    """'append' / 'remove' when the call is ``gc.callbacks.append(...)``
+    or ``gc.callbacks.remove(...)``; '' otherwise."""
+    func = node.func
+    if (
+        isinstance(func, ast.Attribute)
+        and func.attr in ("append", "remove")
+        and isinstance(func.value, ast.Attribute)
+        and func.value.attr == "callbacks"
+        and isinstance(func.value.value, ast.Name)
+        and func.value.value.id == "gc"
+    ):
+        return func.attr
+    return ""
 
 
 def _func_name(node: ast.Call) -> str:

@@ -527,6 +527,82 @@ def _find_journal(statuses) -> List[dict]:
     return out
 
 
+def _find_hotspot(statuses) -> List[dict]:
+    """The profile x decomposition join (obs/profiler.py x obs/perf.py):
+    when a ``-profile`` target's busy samples concentrate in one frame,
+    NAME it — and when the PR 12 segment decomposition also has a
+    dominant segment, say which wall that code is ("host_prep 58% of the
+    turn; 71% of busy samples in pickle.dumps"). Idle leaves (accept/
+    select/wait) are excluded: a parked server thread is not a hotspot."""
+    from .perf import decomposition_summary
+    from .profiler import is_idle_frame
+
+    out = []
+    for label, payload in statuses.items():
+        pw = payload.get("profile")
+        if not isinstance(pw, dict):
+            continue
+        stacks = pw.get("stacks") or 0
+        busy = [
+            r for r in pw.get("frames") or []
+            if isinstance(r, dict) and (r.get("self") or 0) > 0
+            and not is_idle_frame(
+                str(r.get("func", "")), str(r.get("file", ""))
+            )
+        ]
+        if stacks < 20 or not busy:
+            continue  # too few samples to name anything honestly
+        busy_total = sum(r.get("self") or 0 for r in busy)
+        if not busy_total:
+            continue
+        top = busy[0]  # windows ship hottest-self-first
+        share = (top.get("self") or 0) / busy_total
+        if share < 0.25:
+            continue
+        func = str(top.get("func", "?"))
+        where = f"{top.get('file', '?')}:{top.get('line', '?')}"
+        evidence = [
+            f"{top.get('self')} of {busy_total} busy sample(s) "
+            f"({share:.0%}) at {func} ({where}); {stacks} stacks total "
+            f"@ {pw.get('period_ms', '?')}ms cadence"
+        ]
+        for hs in pw.get("hot_stacks") or []:
+            # caller context: the hottest leaf path through this frame —
+            # a leaf alone (e.g. a helper) can be ambiguous
+            if isinstance(hs, dict) and func in str(hs.get("stack", "")):
+                evidence.append(
+                    f"hot path ({hs.get('self')} hit(s)): {hs['stack']}"
+                )
+                break
+        seg_note = ""
+        decomp = decomposition_summary(payload.get("metrics") or {})
+        hot_seg, hot_share, hot_comp = None, 0.0, None
+        for comp, segs in decomp.items():
+            for seg, e in segs.items():
+                if not seg.startswith("_") and isinstance(e, dict) \
+                        and e.get("share", 0) > hot_share:
+                    hot_seg, hot_share, hot_comp = seg, e["share"], comp
+        if hot_seg and hot_share >= 0.4:
+            seg_note = (
+                f" while segment '{hot_seg}' holds {hot_share:.0%} of "
+                f"{hot_comp}'s decomposed wall"
+            )
+            evidence.append(
+                f"gol_turn_segment_seconds: {hot_comp}/{hot_seg} "
+                f"share {hot_share:.0%}"
+            )
+        out.append(_finding(
+            "warn", 40.0 + 55.0 * share,
+            f"hotspot: {func} holds {share:.0%} of busy samples",
+            f"the continuous profiler names {func} ({where}) as the "
+            f"dominant busy frame{seg_note}. If this is unexpected, "
+            "diff against a clean run: python -m "
+            "gol_distributed_final_tpu.obs.flame -diff OLD NEW.",
+            evidence, [], label,
+        ))
+    return out
+
+
 _HEURISTICS = (
     _find_unreachable,
     _find_lost_workers,
@@ -539,6 +615,7 @@ _HEURISTICS = (
     _find_hbm,
     _find_checkpoint,
     _find_journal,
+    _find_hotspot,
 )
 
 
@@ -664,6 +741,11 @@ _BUNDLE_GLOBS = (
     ("doctor", "doctor_*.json", 3),
     ("history", "history_*.json", 3),
     ("journal", "journal_*.jsonl", None),
+    # continuous-profiler artifacts (obs/profiler.py): the run-end and
+    # crash profiles of every process — the flame/diff feedstock; 6
+    # keeps both forms for a broker + a couple of workers
+    ("profile", "profile_*.collapsed", 6),
+    ("profile", "profile_*.speedscope.json", 6),
     ("analysis", "analysis.json", 1),
 )
 
@@ -722,9 +804,13 @@ def write_bundle(
             try:
                 shutil.copy2(src, dst)
             except OSError as exc:
-                entries.append({
-                    "file": src.name, "source": f"{kind} artifact",
-                    "error": str(exc),
+                # a copy failure is ALSO a dropped file: stamp it into
+                # the same manifest list with its family and reason (the
+                # cap-drop shape, applied uniformly), so a postmortem
+                # reads ONE list of what this bundle is missing and why
+                dropped.append({
+                    "file": src.name, "kind": kind,
+                    "why": f"copy failed: {exc}",
                 })
                 continue
             entries.append({

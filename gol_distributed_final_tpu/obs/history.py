@@ -89,7 +89,13 @@ def merge_events(
     return out
 
 
-def _matches(event: dict, tenant: Optional[str], address: Optional[str]) -> bool:
+def _matches(
+    event: dict,
+    tenant: Optional[str],
+    address: Optional[str],
+    since_ms: Optional[int] = None,
+    until_ms: Optional[int] = None,
+) -> bool:
     if tenant is not None:
         args = event.get("args") or {}
         if str(args.get("tenant", "")) != tenant:
@@ -100,6 +106,17 @@ def _matches(event: dict, tenant: Optional[str], address: Optional[str]) -> bool
         if address not in str(event.get("name", "")) and address not in _node(
             event
         ):
+            return False
+    if since_ms is not None or until_ms is not None:
+        # time-window filter on the HLC's PHYSICAL milliseconds (the
+        # sort key's first component) — the same clock the render
+        # stamps, so a window cut from a rendered timeline round-trips.
+        # Both bounds inclusive; an event with no usable stamp (hlc_key
+        # falls back to 0) only survives an unbounded-below window.
+        phys = _journal.hlc_key(event)[0]
+        if since_ms is not None and phys < since_ms:
+            return False
+        if until_ms is not None and phys > until_ms:
             return False
     return True
 
@@ -150,9 +167,13 @@ def build_history(
     tenant: Optional[str] = None,
     address: Optional[str] = None,
     timeout: float = 5.0,
+    since_ms: Optional[int] = None,
+    until_ms: Optional[int] = None,
 ) -> dict:
     """The full reconstruction: segments + live windows -> one merged,
-    filtered, HLC-ordered history dict (schema ``gol-history/1``)."""
+    filtered, HLC-ordered history dict (schema ``gol-history/1``).
+    ``since_ms``/``until_ms`` bound the window on HLC physical
+    milliseconds (unix epoch ms, both inclusive)."""
     seg_paths = _journal.segment_paths(out_dir)
     seg_events, problems = _journal.read_segments(seg_paths)
     live_events: List[dict] = []
@@ -162,7 +183,10 @@ def build_history(
         )
         problems.extend(live_problems)
     merged = merge_events(seg_events, live_events)
-    filtered = [e for e in merged if _matches(e, tenant, address)]
+    filtered = [
+        e for e in merged
+        if _matches(e, tenant, address, since_ms, until_ms)
+    ]
     by_kind: dict = {}
     nodes = set()
     for e in filtered:
@@ -176,7 +200,10 @@ def build_history(
         "nodes": sorted(nodes),
         "events_total": len(filtered),
         "by_kind": dict(sorted(by_kind.items())),
-        "filters": {"tenant": tenant, "address": address},
+        "filters": {
+            "tenant": tenant, "address": address,
+            "since_ms": since_ms, "until_ms": until_ms,
+        },
         "problems": problems,
         "events": filtered,
     }
@@ -272,6 +299,18 @@ def main(argv=None) -> int:
              "readmissions, quarantines) or emitted by it",
     )
     parser.add_argument(
+        "-since", type=int, default=None, metavar="MS",
+        help="filter: only events whose HLC physical stamp is at or "
+             "after this unix-epoch millisecond (the merge's sort key — "
+             "skew-safe across processes, unlike per-host wall clocks)",
+    )
+    parser.add_argument(
+        "-until", type=int, default=None, metavar="MS",
+        help="filter: only events whose HLC physical stamp is at or "
+             "before this unix-epoch millisecond (pairs with -since to "
+             "cut an incident window out of a long run)",
+    )
+    parser.add_argument(
         "-show", type=int, default=DEFAULT_SHOW, metavar="N",
         help=f"terminal rows rendered (default {DEFAULT_SHOW}; 0 = all); "
              "the JSON artifact always carries every event",
@@ -289,6 +328,8 @@ def main(argv=None) -> int:
         tenant=args.tenant,
         address=args.address,
         timeout=args.timeout,
+        since_ms=args.since,
+        until_ms=args.until,
     )
     print(render(history, show=args.show))
     path = write_history(history, args.dir)

@@ -549,6 +549,42 @@ JOURNAL_DROPS_TOTAL = _R.counter(
     "contract that it never loses it silently.",
 )
 
+# -- continuous profiler (obs/profiler.py) -----------------------------------
+
+PROFILE_SAMPLES_TOTAL = _R.counter(
+    "gol_profile_samples_total",
+    "Sampling ticks the continuous profiler (obs/profiler.py, the "
+    "-profile [MS] flags) completed — each walks every thread's stack "
+    "into the bounded call-tree trie. Rate vs the configured cadence "
+    "shows adaptive backoff in action.",
+)
+PROFILE_BACKOFFS_TOTAL = _R.counter(
+    "gol_profile_backoffs_total",
+    "Times the profiler DOUBLED its own cadence because sampling cost "
+    "exceeded its budget share (default 1%) of the period — the "
+    "profiler refusing to become the hotspot it exists to find. A "
+    "climbing value means the process has too many/too-deep threads "
+    "for the configured -profile cadence.",
+)
+
+# -- GC observability (obs/profiler.py gc.callbacks hook) --------------------
+
+GC_PAUSE_SECONDS = _R.histogram(
+    "gol_gc_pause_seconds",
+    "Stop-the-world garbage-collection pause walls (gc.callbacks "
+    "start->stop), metered while the profiler runs. Feeds the "
+    "'gc-pause' SLO rule: a pause is wall time no turn-segment "
+    "decomposition can name, and past ~50 ms it IS the p99.",
+    buckets=(0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0),
+)
+GC_COLLECTIONS_TOTAL = _R.counter(
+    "gol_gc_collections_total",
+    "Garbage-collection passes by generation ('0'/'1'/'2'), metered "
+    "while the profiler runs. A hot gen-2 rate alongside gc-pause "
+    "spikes usually means a reference-cycle churn in the serving path.",
+    labelnames=("gen",),
+)
+
 # -- lock sanitizer (utils/locksan.py) ---------------------------------------
 
 LOCKSAN_VIOLATIONS_TOTAL = _R.counter(

@@ -101,6 +101,11 @@ _REQUIRED_SECTIONS = (
     # obs/history.py): the event-kind table, the HLC semantics, the
     # retention knobs, and the history CLI examples
     "## Journal & history",
+    # the continuous-profiler contract (obs/profiler.py + obs/flame.py):
+    # the cadence/backoff knobs, overhead budget, artifact formats
+    # (collapsed + speedscope), flame diff semantics, and the GC pause
+    # meter feeding the gc-pause SLO rule
+    "## Profiling",
 )
 
 # the wire data-plane metric families (rpc/protocol.py frames + the
@@ -364,6 +369,31 @@ def undocumented_journal_names(readme_path=None) -> List[str]:
     return sorted(n for n in _JOURNAL_DOC_NAMES if n not in section)
 
 
+# the continuous-profiler contract names (obs/profiler.py sampler +
+# obs/flame.py render/diff CLI): the sampler meters, the GC pause
+# surface, the enablement knob, and the incremental Status window field
+# — these must be documented in the README's "Profiling" section
+# specifically, the operator contract flame graphs and the doctor's
+# hotspot finding are read against
+_PROFILER_DOC_NAMES = (
+    "gol_profile_samples_total",
+    "gol_profile_backoffs_total",
+    "gol_gc_pause_seconds",
+    "gol_gc_collections_total",
+    "-profile",
+    "profile_since",
+)
+
+
+def undocumented_profiler_names(readme_path=None) -> List[str]:
+    """Profiler metric/knob names missing from the README's "Profiling"
+    section specifically (the wire/device-table posture: a name
+    mentioned elsewhere in the file does not count as documented
+    here)."""
+    section = _readme_section(readme_path, "## Profiling")
+    return sorted(n for n in _PROFILER_DOC_NAMES if n not in section)
+
+
 def undeclared_journal_kinds(readme_path=None, package_root=None) -> List[str]:
     """Registry drift between the journal's event-kind table and its
     emit sites: every literal kind passed to ``journal.record(...)``
@@ -515,6 +545,14 @@ CHECKS = (
         "history section:",
         "journal lint ok: every journal metric and knob is in the "
         "Journal & history section",
+    ),
+    (
+        "lint-profiler-metrics",
+        undocumented_profiler_names,
+        "profiler metric/knob names missing from README.md's Profiling "
+        "section:",
+        "profiler lint ok: every profiler metric and knob is in the "
+        "Profiling section",
     ),
     (
         "lint-journal-kinds",

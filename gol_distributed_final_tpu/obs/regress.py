@@ -194,6 +194,7 @@ def compare_case(
         out = _apply_sparse_gates(old, new, out, threshold, 0.0)
         out = _apply_fused_gate(old, new, out, threshold)
         out = _apply_journal_gate(old, new, out, threshold)
+        out = _apply_profile_gate(old, new, out, threshold)
         return _apply_wire_bytes_gate(old, new, out, threshold)
     delta = new_us - old_us
     rel = delta / old_us
@@ -218,6 +219,7 @@ def compare_case(
     out = _apply_sparse_gates(old, new, out, threshold, noise_us / old_us)
     out = _apply_fused_gate(old, new, out, threshold)
     out = _apply_journal_gate(old, new, out, threshold)
+    out = _apply_profile_gate(old, new, out, threshold)
     return _apply_wire_bytes_gate(old, new, out, threshold)
 
 
@@ -337,6 +339,63 @@ def _apply_journal_gate(
             out["why"] = (
                 "journal overhead grew past the cross-round threshold"
             )
+    return out
+
+
+def _apply_profile_gate(
+    old: dict, new: dict, out: dict, threshold: float
+) -> dict:
+    """The profiler-cost trajectory gate (ISSUE 17): the wire bench's
+    profiler pair embeds ``profile_overhead_pct`` (profiler-on vs
+    profiler-off resident K=8). bench.py's own run-time gate holds each
+    round under 2% beyond its noise band; THIS gate is the cross-round
+    backstop — sampling overhead creeping up by more than
+    ``100 * threshold`` percentage points between rounds is REGRESSED
+    even if a loosened per-round band let it through (the journal gate's
+    pattern, applied to the sampler's hot path: _extract_stacks and
+    _fold). The embedded ``profile_hot`` table (top busy frames with
+    ``self_share``) also rides along: the top mover between rounds is
+    always REPORTED, and a frame's share growing by more than 0.35
+    absolute gates — sampling shares jitter, so only a wholesale shift
+    of the profile's center of mass (a new dominant frame) is a verdict,
+    not a few points of drift."""
+    old_p, new_p = old.get("profile_overhead_pct"), new.get("profile_overhead_pct")
+    if old_p is not None and new_p is not None:
+        out["old_profile_overhead_pct"] = old_p
+        out["new_profile_overhead_pct"] = new_p
+        out["profile_overhead_delta_pts"] = round(new_p - old_p, 2)
+        if new_p - old_p > 100.0 * threshold:
+            out["verdict"] = "REGRESSED"
+            out["why"] = (
+                "profiler overhead grew past the cross-round threshold"
+            )
+    old_h, new_h = old.get("profile_hot"), new.get("profile_hot")
+    if isinstance(old_h, list) and isinstance(new_h, list) and new_h:
+        shares_old = {
+            r.get("frame"): r.get("self_share") or 0.0
+            for r in old_h if isinstance(r, dict)
+        }
+        movers = sorted(
+            (
+                (
+                    (r.get("self_share") or 0.0)
+                    - shares_old.get(r.get("frame"), 0.0),
+                    str(r.get("frame")),
+                )
+                for r in new_h
+                if isinstance(r, dict) and r.get("frame")
+            ),
+            reverse=True,
+        )
+        if movers:
+            delta_share, frame = movers[0]
+            out["profile_top_mover"] = frame
+            out["profile_top_mover_delta_share"] = round(delta_share, 3)
+            if delta_share > 0.35:
+                out["verdict"] = "REGRESSED"
+                out["why"] = (
+                    "the profile's dominant frame shifted between rounds"
+                )
     return out
 
 

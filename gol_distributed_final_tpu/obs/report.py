@@ -25,13 +25,14 @@ from . import accounting, flight, metrics, timeline, tracing
 from . import critical as _critical
 from . import journal as _journal
 from . import perf as _perf
+from . import profiler as _profiler
 
 SCHEMA = "gol-run-report/1"
 
 
 def status_payload(
     timeline_since: int = 0, accounting_since: int = 0,
-    journal_since: int = 0, **extra
+    journal_since: int = 0, profile_since: int = 0, **extra
 ) -> dict:
     """The ``Status`` verb's reply body: registry snapshot + identity.
 
@@ -93,6 +94,13 @@ def status_payload(
         # the live half of `python -m ..obs.history` and the watch
         # JOURNAL panel; only events past the caller's journal_since
         payload["journal"] = jw
+    pw = _profiler.window(since=profile_since)
+    if pw is not None:
+        # the continuous profiler's incremental window (obs/profiler.py)
+        # — only frames whose hits moved past the caller's profile_since
+        # seq; the doctor's hotspot join, the watch PROFILE panel, and
+        # obs/flame.py's live lane all read this
+        payload["profile"] = pw
     payload.update(extra)
     return payload
 
@@ -221,6 +229,12 @@ def write_run_report(
         # and drop/rotation accounting (the segments on disk hold the
         # full causally-stamped history)
         report["journal"] = js
+    ps = _profiler.summary()
+    if ps is not None:
+        # WHICH CODE the wall went to: the profiler's head + top frames
+        # (the full trie lands in the collapsed/speedscope artifacts the
+        # mains write at run end — obs/flame.py renders those)
+        report["profile"] = ps
     decomp = _perf.decomposition_summary(snap)
     if decomp:
         # WHERE the wall went: the dispatch-wall decomposition breakdown

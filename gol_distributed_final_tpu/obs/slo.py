@@ -322,6 +322,15 @@ def default_rules() -> List[Rule]:
             "gol_worker_skew_ratio", factor=2.0, window_s=120.0,
             floor=1.5,
         ),
+        # stop-the-world GC pauses (obs/profiler.py's gc.callbacks
+        # hook): a 50 ms pause under a 250 ms turn budget IS the p99,
+        # and no segment decomposition will ever name it — the rule
+        # only arms while a -profile run is metering pauses
+        QuantileRule(
+            "gc-pause", "warn", "gol_gc_pause_seconds",
+            q=0.99, threshold=0.05, fast_s=30.0, slow_s=120.0,
+            min_count=3,
+        ),
     ]
 
 
@@ -337,6 +346,7 @@ DEFAULT_RULE_NAMES = (
     "hbm-headroom",
     "scatter-deadline-growth",
     "worker-skew",
+    "gc-pause",
 )
 
 

@@ -81,6 +81,7 @@ def fetch_status(
     timeout: float = 10.0,
     timeline_since: int = 0,
     journal_since: int = 0,
+    profile_since: int = 0,
 ) -> dict:
     """One Status round-trip against a broker (default) or worker.
 
@@ -90,6 +91,9 @@ def fetch_status(
     full ring, and a pre-timeline server ignores the field entirely.
     ``journal_since`` is the lifecycle journal's twin (obs/journal.py):
     a ``-journal`` server ships only events past this seq.
+    ``profile_since`` is the continuous profiler's twin
+    (obs/profiler.py): a ``-profile`` server ships only frames whose
+    hit counts moved past this seq.
 
     Raises ``StatusUnavailable`` (with a mode-specific message, see
     ``extract_status``) instead of returning an empty dict, so callers
@@ -107,6 +111,7 @@ def fetch_status(
             Request(
                 timeline_since=timeline_since,
                 journal_since=journal_since,
+                profile_since=profile_since,
             ),
             timeout=timeout,
         )

@@ -107,6 +107,13 @@ _TIMELINE_KEYS = (
     "gol_rpc_dispatch_seconds{method=Operations.SessionRun}",
     "gol_rpc_server_errors_total",
     "gol_scatter_deadline_seconds",
+    # GC observability (obs/profiler.py's gc.callbacks hook): pause
+    # quantiles + per-generation collection rates on the dashboard —
+    # a stop-the-world pause is wall no segment decomposition names
+    "gol_gc_pause_seconds",
+    "gol_gc_collections_total{gen=0}",
+    "gol_gc_collections_total{gen=1}",
+    "gol_gc_collections_total{gen=2}",
 )
 
 
@@ -569,6 +576,53 @@ def _journal_lines(payload: dict, tail: int = 8) -> List[str]:
     return out
 
 
+def _profile_lines(payload: dict, top: int = 6) -> List[str]:
+    """The continuous profiler's hot-frame shortlist (obs/profiler.py
+    window): self/cum shares of the hottest frames, the adaptive
+    cadence, and the gc-pause tally. Parked frames (accept/select/wait
+    leaves) are skipped — the busy view; the full table stays pollable
+    via obs/flame.py."""
+    from .profiler import is_idle_frame
+
+    pw = payload.get("profile")
+    if not isinstance(pw, dict):
+        return []
+    stacks = pw.get("stacks") or 0
+    head = (
+        f"PROFILE (seq {pw.get('seq', '?')}, {stacks:,} stacks @ "
+        f"{pw.get('period_ms', '?')}ms)"
+    )
+    backoffs = pw.get("backoffs") or 0
+    if backoffs:
+        head = head[:-1] + f", {backoffs} backoff(s))"
+    out = [head]
+    gc_sect = pw.get("gc") or {}
+    if gc_sect.get("pauses"):
+        out.append(
+            f"  gc: {gc_sect['pauses']} pause(s), "
+            f"max {_human_seconds(gc_sect.get('max_pause_s') or 0)}, "
+            f"total {_human_seconds(gc_sect.get('pause_s') or 0)}"
+        )
+    shown = 0
+    for row in pw.get("frames") or []:
+        if shown >= top:
+            break
+        if is_idle_frame(str(row.get("func", "")), str(row.get("file", ""))):
+            continue
+        s = row.get("self") or 0
+        c = row.get("cum") or 0
+        denom = max(stacks, 1)
+        out.append(
+            f"  {100.0 * s / denom:>5.1f}% self {100.0 * c / denom:>5.1f}% "
+            f"cum  {row.get('func', '?')} "
+            f"({row.get('file', '?')}:{row.get('line', '?')})"
+        )
+        shown += 1
+    if shown == 0:
+        out.append("  no busy frames sampled yet")
+    return out
+
+
 def render_status(
     label: str,
     payload: dict,
@@ -602,6 +656,7 @@ def render_status(
         _hbm_lines(snap),
         _flight_lines(payload),
         _journal_lines(payload),
+        _profile_lines(payload),
     ]
     lines = [head]
     for sec in sections:
@@ -624,6 +679,12 @@ class Watcher:
         self._tl_seq: Dict[str, int] = {}
         # addr -> last journal seq received (the journal twin)
         self._jr_seq: Dict[str, int] = {}
+        # addr -> last profile seq received + the frame cache the
+        # incremental windows overlay (a -profile server ships only
+        # frames whose hits MOVED past the echoed seq; the dashboard
+        # merges them over what it already holds)
+        self._pr_seq: Dict[str, int] = {}
+        self._pr_frames: Dict[str, Dict[tuple, dict]] = {}
 
     def _turns_rate(self, addr: str, payload: dict) -> Optional[float]:
         now = time.monotonic()
@@ -641,6 +702,25 @@ class Watcher:
         # negative/garbage rate; reset-aware, the new total IS the delta
         return counter_delta(turns0, turns) / dt if dt > 0 else None
 
+    def _merge_profile(self, addr: str, payload: dict) -> None:
+        """Overlay an incremental profile window onto the cached frame
+        table: a frame absent from this window simply hasn't MOVED since
+        the echoed seq — its last-known counts still render."""
+        pw = payload.get("profile")
+        if not isinstance(pw, dict):
+            return
+        seq = pw.get("seq")
+        if isinstance(seq, int):
+            self._pr_seq[addr] = seq
+        cache = self._pr_frames.setdefault(addr, {})
+        for row in pw.get("frames") or []:
+            if isinstance(row, dict):
+                cache[(row.get("func"), row.get("file"),
+                       row.get("line"))] = row
+        pw["frames"] = sorted(
+            cache.values(), key=lambda r: -(r.get("self") or 0)
+        )[:40]
+
     def frame(self) -> Tuple[str, bool]:
         """(rendered frame, primary target ok)."""
         stamp = time.strftime("%Y-%m-%d %H:%M:%S")
@@ -653,6 +733,7 @@ class Watcher:
                     addr, worker=is_worker, timeout=self.timeout,
                     timeline_since=self._tl_seq.get(addr, 0),
                     journal_since=self._jr_seq.get(addr, 0),
+                    profile_since=self._pr_seq.get(addr, 0),
                 )
                 seq = (payload.get("timeline") or {}).get("seq")
                 if isinstance(seq, int):
@@ -660,6 +741,7 @@ class Watcher:
                 jseq = (payload.get("journal") or {}).get("seq")
                 if isinstance(jseq, int):
                     self._jr_seq[addr] = jseq
+                self._merge_profile(addr, payload)
             except StatusUnavailable as exc:
                 blocks.append(f"== {kind} {addr}: no status — {exc}")
                 continue

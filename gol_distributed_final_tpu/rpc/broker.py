@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import pathlib
 import random
 import threading
@@ -40,6 +41,7 @@ from ..obs import instruments as _ins
 from ..obs import journal as _journal
 from ..obs import metrics as _metrics
 from ..obs import perf as _perf
+from ..obs import profiler as _profiler
 from ..obs import tracing as _tracing
 from ..utils import locksan as _locksan
 from . import faults as _faults
@@ -2176,11 +2178,14 @@ class BrokerService:
         asince = getattr(req, "accounting_since", 0)
         # journal_since: the lifecycle-journal twin (obs/journal.py)
         jsince = getattr(req, "journal_since", 0)
+        # profile_since: the continuous profiler's twin (obs/profiler.py)
+        psince = getattr(req, "profile_since", 0)
         payload = status_payload(
             role="broker", backend=type(self.backend).__name__,
             timeline_since=since if isinstance(since, int) else 0,
             accounting_since=asince if isinstance(asince, int) else 0,
             journal_since=jsince if isinstance(jsince, int) else 0,
+            profile_since=psince if isinstance(psince, int) else 0,
         )
         health = getattr(self.backend, "worker_health", None)
         if callable(health):
@@ -2412,6 +2417,17 @@ def main(argv=None) -> None:
              "python -m ...obs.history after the fact",
     )
     parser.add_argument(
+        "-profile", nargs="?", const=10.0, default=None, type=float,
+        metavar="MS",
+        help="enable the continuous sampling profiler (obs/profiler.py): "
+             "a daemon sampler walks every thread's stack at this cadence "
+             "(default 10 ms, adaptive backoff past its 1%% budget) into "
+             "a bounded call tree; ships incremental windows in Status "
+             "replies, writes collapsed-stack + speedscope artifacts at "
+             "run end and on crash (render/diff with "
+             "python -m ...obs.flame); implies -metrics",
+    )
+    parser.add_argument(
         "-canary", nargs="?", const=5.0, default=None, type=float,
         metavar="SECS",
         help="run the blackbox canary prober (obs/canary.py) in-process "
@@ -2452,6 +2468,12 @@ def main(argv=None) -> None:
         flight.enable()
     if args.journal is not None:
         _journal.enable(out_dir=args.journal, role="broker")
+    if args.profile is not None:
+        if args.profile <= 0:
+            parser.error(f"-profile MS must be > 0, got {args.profile}")
+        _profiler.enable(
+            period_ms=args.profile, tag=f"broker_{os.getpid()}"
+        )  # implies metrics.enable()
     _integrity.set_enabled(args.integrity == "on")
     if args.ckpt_keep < 1:
         parser.error(f"-ckpt-keep must be >= 1, got {args.ckpt_keep}")
@@ -2579,11 +2601,13 @@ def main(argv=None) -> None:
         # propagating — the postmortem evidence for a dead broker
         _flight.dump_on_crash(exc)
         _journal.flush_on_crash(exc)
+        _profiler.flush_on_crash(exc)
         raise
     finally:
         if canary is not None:
             canary.stop()
         _journal.disable()  # flush + close the segment cleanly
+        _profiler.shutdown()  # run-end collapsed/speedscope artifacts
 
 
 if __name__ == "__main__":

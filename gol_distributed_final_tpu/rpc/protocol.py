@@ -146,6 +146,13 @@ class Request:
     # echoes the last journal ``seq`` it received and the server ships
     # only newer tail events (obs/history.py rides it).
     journal_since: int = 0
+    # extension: incremental profile windows (obs/profiler.py) —
+    # timeline_since's twin for the continuous sampling profiler: a
+    # Status caller echoes the last profile ``seq`` it received and the
+    # server ships only frames whose hit counts moved since (the window
+    # head — cadence, stacks, gc pauses — always rides). Same skew
+    # posture: getattr, absent/0 = the full frame table.
+    profile_since: int = 0
 
 
 @dataclasses.dataclass

@@ -544,10 +544,12 @@ class WorkerService:
 
         since = getattr(req, "timeline_since", 0)
         jsince = getattr(req, "journal_since", 0)
+        psince = getattr(req, "profile_since", 0)
         return Response(status=status_payload(
             role="worker",
             timeline_since=since if isinstance(since, int) else 0,
             journal_since=jsince if isinstance(jsince, int) else 0,
+            profile_since=psince if isinstance(psince, int) else 0,
         ))
 
     def _shutdown(self):
@@ -609,10 +611,27 @@ def main(argv=None) -> None:
              "and size-rotated; merged cross-process by "
              "python -m ...obs.history",
     )
+    parser.add_argument(
+        "-profile", nargs="?", const=10.0, default=None, type=float,
+        metavar="MS",
+        help="enable the continuous sampling profiler (obs/profiler.py) "
+             "at this cadence (default 10 ms, adaptive backoff): "
+             "incremental windows in Status replies, collapsed-stack + "
+             "speedscope artifacts at run end and on crash; implies "
+             "-metrics",
+    )
     args = parser.parse_args(argv)
     _integrity.set_enabled(args.integrity == "on")
     if args.journal is not None:
         _journal.enable(out_dir=args.journal, role="worker")
+    if args.profile is not None:
+        if args.profile <= 0:
+            parser.error(f"-profile MS must be > 0, got {args.profile}")
+        from ..obs import profiler as _profiler
+
+        _profiler.enable(
+            period_ms=args.profile, tag=f"worker_{os.getpid()}"
+        )  # implies metrics.enable()
     if args.metrics:
         from ..obs import metrics
 
@@ -642,12 +661,17 @@ def main(argv=None) -> None:
         # the postmortem evidence for a dead worker (satellite of the
         # broker __main__ hook; both were engine-only before)
         from ..obs import flight as _flight
+        from ..obs import profiler as _profiler
 
         _flight.dump_on_crash(exc)
         _journal.flush_on_crash(exc)
+        _profiler.flush_on_crash(exc)
         raise
     finally:
+        from ..obs import profiler as _profiler
+
         _journal.disable()  # flush + close the segment cleanly
+        _profiler.shutdown()  # run-end collapsed/speedscope artifacts
 
 
 if __name__ == "__main__":

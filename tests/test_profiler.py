@@ -1,0 +1,971 @@
+"""Continuous-profiler tests (obs/profiler.py, obs/flame.py): bounded
+trie/table folds, adaptive cadence backoff + decay, incremental
+``profile_since`` windows (skew-safe, old-pickle posture), collapsed +
+speedscope artifact round-trips through the flame loader, diff math,
+the doctor hotspot join, GC-pause metering, the regress cross-round
+gates, bundle collection, history time-windows, the hygiene gc-callback
+checker — and one live drill: a sleep-slowed worker on a resident
+cluster is NAMED by the doctor and flagged by the flame diff.
+"""
+
+import gc
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from gol_distributed_final_tpu.obs import flame
+from gol_distributed_final_tpu.obs import metrics as obs_metrics
+from gol_distributed_final_tpu.obs import profiler as obs_profiler
+from gol_distributed_final_tpu.obs.profiler import (
+    ContinuousProfiler,
+    frame_name,
+    is_idle_frame,
+)
+from gol_distributed_final_tpu.obs.status import scalar_value, series_map
+
+from helpers import REPO_ROOT
+
+
+@pytest.fixture
+def live_metrics():
+    """Enable the process-global registry for one test, zeroed before and
+    disabled+zeroed after (the test_slo.py posture)."""
+    reg = obs_metrics.registry()
+    reg.reset()
+    obs_metrics.enable()
+    yield reg
+    obs_metrics.enable(False)
+    reg.reset()
+
+
+@pytest.fixture(autouse=True)
+def _no_global_profiler():
+    """Every test leaves the process-global profiler OFF — a leaked
+    sampler thread would poison every later test's timing."""
+    yield
+    obs_profiler.disable()
+
+
+def _stack(*frames):
+    """[("f", "pkg/f.py", 1), ...] root-first from 'f' names."""
+    return [(f, f"pkg/{f}.py", i + 1) for i, f in enumerate(frames)]
+
+
+def _tick(p, stacks, n=1):
+    seq = 0
+    for _ in range(n):
+        seq = p.sample_once(cost=0.0, stacks=stacks)
+    return seq
+
+
+# -- sampling: the trie + flat table ------------------------------------------
+
+
+class TestSampling:
+    def test_injected_stacks_deterministic(self):
+        p = ContinuousProfiler(10.0)
+        _tick(p, [("main", _stack("a", "b"))], n=3)
+        rows = p.hot_frames()
+        assert rows[0]["func"] == "b" and rows[0]["self"] == 3
+        assert rows[0]["cum"] == 3
+        a = next(r for r in rows if r["func"] == "a")
+        assert a["self"] == 0 and a["cum"] == 3
+        w = p.window(0)
+        assert w["stacks"] == 3 and w["threads"] == ["main"]
+
+    def test_recursion_counts_once_per_stack(self):
+        p = ContinuousProfiler(10.0)
+        rec = [("f", "pkg/f.py", 1), ("f", "pkg/f.py", 1)]
+        _tick(p, [("main", rec)], n=2)
+        row = next(r for r in p.hot_frames() if r["func"] == "f")
+        assert row["cum"] == 2  # not 4: recursion counts once per stack
+        assert row["self"] == 2
+
+    def test_trie_node_cap_folds_to_other(self):
+        p = ContinuousProfiler(10.0, max_nodes=8, max_frames=512)
+        for i in range(50):
+            _tick(p, [("main", [(f"fn{i}", "pkg/m.py", i + 1)])])
+        w = p.window(0)
+        # the root + at most max_nodes children + the one <other> bucket
+        assert w["nodes"] <= p.max_nodes + 1
+        assert w["stacks"] == 50  # no sample is dropped, only folded
+        assert any("<other>" in line for line in p.collapsed_lines())
+
+    def test_flat_table_cap_folds_to_other(self):
+        p = ContinuousProfiler(10.0, max_nodes=4096, max_frames=8)
+        for i in range(50):
+            _tick(p, [("main", [(f"fn{i}", "pkg/m.py", i + 1)])])
+        rows = p.hot_frames(top=1000)
+        assert len(rows) <= 9  # 8 real frames + the <other> bucket
+        other = next(r for r in rows if r["func"] == "<other>")
+        assert other["self"] >= 42  # the folded tail's self hits land there
+
+    def test_adaptive_backoff_doubles_and_meters(self, live_metrics):
+        p = ContinuousProfiler(10.0, budget=0.01)
+        p.sample_once(cost=1.0, stacks=[])  # ewma 0.2s >> 1% of 10ms
+        assert p.period_s == pytest.approx(0.02)
+        for _ in range(10):
+            p.sample_once(cost=1.0, stacks=[])
+        assert p.period_s == pytest.approx(p.max_period_s)  # capped
+        w = p.window(0)
+        assert w["backoffs"] >= 1
+        snap = live_metrics.snapshot()
+        assert scalar_value(snap, "gol_profile_backoffs_total") >= 1
+        assert scalar_value(snap, "gol_profile_samples_total") >= 11
+
+    def test_adaptive_decay_returns_to_base(self):
+        p = ContinuousProfiler(10.0, budget=0.01)
+        p.sample_once(cost=1.0, stacks=[])
+        assert p.period_s > p.base_period_s
+        for _ in range(300):
+            p.sample_once(cost=0.0, stacks=[])
+        assert p.period_s == pytest.approx(p.base_period_s)
+        assert p.window(0)["backoffs"] >= 1  # history is not erased
+
+    def test_window_incremental_since(self):
+        p = ContinuousProfiler(10.0)
+        _tick(p, [("main", _stack("a"))])
+        w1 = p.window(0)
+        assert [r["func"] for r in w1["frames"]] == ["a"]
+        # nothing moved since: the incremental window ships no frames
+        assert p.window(w1["seq"])["frames"] == []
+        _tick(p, [("main", _stack("b"))])
+        w2 = p.window(w1["seq"])
+        assert [r["func"] for r in w2["frames"]] == ["b"]  # only the mover
+        assert w2["seq"] == w1["seq"] + 1
+        # the head still rides every window, frames or not
+        assert w2["stacks"] == 2 and w2["schema"] == "gol-profile/1"
+
+    def test_window_is_json_serializable(self):
+        p = ContinuousProfiler(10.0)
+        _tick(p, [("main", _stack("a", "b"))], n=2)
+        doc = json.loads(json.dumps(p.window(0)))
+        assert doc["schema"] == "gol-profile/1"
+        assert doc["gc"]["tracked"] is False
+
+    def test_summary_caps_frames_at_ten(self):
+        p = ContinuousProfiler(10.0)
+        for i in range(20):
+            _tick(p, [("main", [(f"fn{i}", "pkg/m.py", 1)])])
+        assert len(p.summary()["frames"]) == 10
+        assert len(p.window(0)["frames"]) == 20
+
+    def test_hot_stacks_leaf_paths(self):
+        p = ContinuousProfiler(10.0)
+        _tick(p, [("main", _stack("a", "b"))], n=3)
+        _tick(p, [("main", _stack("a", "c"))], n=1)
+        rows = p.hot_stacks()
+        assert rows[0]["self"] == 3
+        assert rows[0]["stack"].endswith("b (pkg/b.py:2)")
+        assert "a (pkg/a.py:1)" in rows[0]["stack"]
+
+    def test_constructor_validation(self):
+        with pytest.raises(ValueError):
+            ContinuousProfiler(0.0)
+        with pytest.raises(ValueError):
+            ContinuousProfiler(10.0, max_nodes=2)
+
+    def test_real_stack_extraction_names_this_test(self):
+        """No injection: a real sample of a live helper thread must name
+        the helper's own function."""
+        import threading
+
+        stop = threading.Event()
+
+        def profiler_target_spin():
+            while not stop.is_set():
+                sum(range(50))
+
+        t = threading.Thread(target=profiler_target_spin, daemon=True)
+        t.start()
+        try:
+            p = ContinuousProfiler(10.0)
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                p.sample_once(cost=0.0)
+                if any(
+                    "profiler_target_spin" in r["func"]
+                    for r in p.hot_frames(top=1000)
+                ):
+                    break
+            else:
+                pytest.fail("live thread never sampled by name")
+        finally:
+            stop.set()
+            t.join()
+
+
+# -- artifacts: collapsed + speedscope ----------------------------------------
+
+
+class TestArtifacts:
+    def _profiled(self):
+        p = ContinuousProfiler(10.0)
+        _tick(p, [("main", _stack("a", "b"))], n=2)
+        _tick(p, [("main", _stack("a"))], n=1)
+        return p
+
+    def test_collapsed_golden(self):
+        p = self._profiled()
+        assert p.collapsed_lines() == [
+            "main;a (pkg/a.py:1) 1",
+            "main;a (pkg/a.py:1);b (pkg/b.py:2) 2",
+        ]
+
+    def test_write_artifacts_tmp_then_rename(self, tmp_path):
+        p = self._profiled()
+        paths = p.write_artifacts(str(tmp_path), "t1")
+        assert [x.name for x in paths] == [
+            "profile_t1.collapsed", "profile_t1.speedscope.json",
+        ]
+        assert all(x.exists() for x in paths)
+        assert not list(tmp_path.glob("*.tmp"))
+
+    def test_speedscope_schema(self):
+        doc = self._profiled().speedscope_dict("x")
+        assert doc["$schema"] == (
+            "https://www.speedscope.app/file-format-schema.json"
+        )
+        assert {f["name"] for f in doc["shared"]["frames"]} == {"a", "b"}
+        prof = doc["profiles"][0]
+        assert prof["type"] == "sampled" and prof["name"] == "main"
+        assert len(prof["samples"]) == len(prof["weights"])
+        assert prof["endValue"] == sum(prof["weights"]) == 3
+
+    def test_collapsed_roundtrip_through_flame(self, tmp_path):
+        paths = self._profiled().write_artifacts(str(tmp_path), "rt")
+        prof = flame.load_collapsed(paths[0])
+        assert prof["total"] == 3
+        assert prof["frames"]["b (pkg/b.py:2)"] == [2, 2]
+        assert prof["frames"]["a (pkg/a.py:1)"] == [1, 3]
+
+    def test_speedscope_roundtrip_matches_collapsed(self, tmp_path):
+        paths = self._profiled().write_artifacts(str(tmp_path), "rt")
+        a = flame.load_collapsed(paths[0])
+        b = flame.load_speedscope(paths[1])
+        assert a["total"] == b["total"]
+        assert a["frames"] == b["frames"]
+
+    def test_parse_frame_inverts_frame_name(self):
+        name = frame_name("step", "/x/y/gol_distributed_final_tpu/ops/k.py", 7)
+        assert flame.parse_frame(name) == (
+            "step", "gol_distributed_final_tpu/ops/k.py", 7
+        )
+        assert flame.parse_frame("just_a_name") == ("just_a_name", "", 0)
+
+    def test_is_idle_frame_semantics(self):
+        assert is_idle_frame("wait", "pkg/anything.py")
+        assert is_idle_frame("step", "/usr/lib/python3/threading.py")
+        # the rpc frame pump parks in sock.recv/sendall: wire-wait, not work
+        assert is_idle_frame(
+            "recv_frame_sized", "gol_distributed_final_tpu/rpc/protocol.py"
+        )
+        assert not is_idle_frame(
+            "fault_point", "gol_distributed_final_tpu/rpc/faults.py"
+        )
+
+
+# -- flame: merge / tables / diffs --------------------------------------------
+
+
+def _prof(source, total, frames):
+    return {"source": source, "total": total,
+            "frames": {k: list(v) for k, v in frames.items()}}
+
+
+class TestFlame:
+    def test_merge_profiles(self):
+        m = flame.merge_profiles([
+            _prof("x", 10, {"a": (5, 10), "b": (5, 5)}),
+            _prof("y", 10, {"a": (2, 2)}),
+        ])
+        assert m["total"] == 20
+        assert m["frames"]["a"] == [7, 12] and m["frames"]["b"] == [5, 5]
+
+    def test_hot_rows_shares_and_active_filter(self):
+        prof = _prof("x", 10, {
+            "work (pkg/w.py:1)": (6, 6),
+            "wait (threading.py:1)": (4, 4),
+        })
+        rows = flame.hot_rows(prof)
+        assert rows[0]["frame"].startswith("work")
+        assert rows[0]["self_share"] == pytest.approx(0.6)
+        assert rows[1]["idle"] is True
+        active = flame.hot_rows(prof, active_only=True)
+        assert [r["frame"] for r in active] == ["work (pkg/w.py:1)"]
+
+    def test_diff_math_and_sort(self):
+        old = _prof("old", 100, {"a": (50, 50), "b": (50, 50)})
+        new = _prof("new", 100, {"a": (80, 80), "c": (20, 20)})
+        movers = flame.diff_profiles(old, new)
+        assert [m["frame"] for m in movers] == ["a", "c", "b"]
+        assert movers[0]["delta_pp"] == pytest.approx(30.0)
+        assert movers[1]["old_share"] == 0.0  # absent side diffs vs zero
+        assert movers[2]["delta_pp"] == pytest.approx(-50.0)
+
+    def test_diff_noise_floor(self):
+        old = _prof("old", 1000, {"a": (500, 500), "b": (500, 500)})
+        new = _prof("new", 1000, {"a": (503, 503), "b": (497, 497)})
+        assert flame.diff_profiles(old, new, noise_pp=0.5) == []
+        assert len(flame.diff_profiles(old, new, noise_pp=0.1)) == 2
+
+    def test_from_window(self):
+        p = ContinuousProfiler(10.0)
+        _tick(p, [("main", _stack("a", "b"))], n=2)
+        prof = flame.from_window(p.window(0), source="t")
+        assert prof["total"] == 2
+        assert prof["frames"]["b (pkg/b.py:2)"] == [2, 2]
+
+    def test_load_bench_round(self, tmp_path):
+        doc = {"c7_profile": {
+            "per_turn_us": 12.0,
+            "profile_hot": [
+                {"frame": "step (ops/k.py:3)", "self_share": 0.62},
+                {"frame": "dumps (rpc/protocol.py:9)", "self_share": 0.2},
+            ],
+        }}
+        path = tmp_path / "BENCH_r01.json"
+        path.write_text(json.dumps(doc))
+        prof = flame.load_bench_round(path)
+        assert prof["total"] == 10000
+        assert prof["frames"]["step (ops/k.py:3)"] == [6200, 0]
+        # and the generic source dispatcher routes BENCH*.json here
+        assert flame.load_source(str(path))["frames"] == prof["frames"]
+
+    def test_render_table_and_diff_render(self):
+        prof = _prof("x", 10, {"work (pkg/w.py:1)": (6, 6)})
+        out = flame.render_table(prof)
+        assert "work (pkg/w.py:1)" in out and "60.0%" in out
+        movers = flame.diff_profiles(
+            _prof("o", 10, {"a": (1, 1)}), _prof("n", 10, {"a": (9, 9)})
+        )
+        text = flame.render_diff(movers, _prof("o", 10, {}),
+                                 _prof("n", 10, {}))
+        assert "+80.00pp" in text and "a" in text
+
+
+# -- gc-pause metering --------------------------------------------------------
+
+
+class TestGcMetering:
+    def test_gc_pause_metering_and_removal(self, live_metrics):
+        p = ContinuousProfiler(10.0)
+        p.install_gc()
+        try:
+            gc.collect()
+            w = p.window(0)
+            assert w["gc"]["tracked"] is True
+            assert w["gc"]["pauses"] >= 1
+            assert w["gc"]["max_pause_s"] >= 0.0
+            snap = live_metrics.snapshot()
+            pause = series_map(snap, "gol_gc_pause_seconds")
+            assert pause and pause[()]["count"] >= 1
+            gens = series_map(snap, "gol_gc_collections_total")
+            assert gens  # labelled by generation
+        finally:
+            p.remove_gc()
+        assert p._gc_callback not in gc.callbacks
+        assert p.window(0)["gc"]["tracked"] is False
+
+    def test_gc_callback_is_lock_free_under_registry_lock(
+        self, live_metrics
+    ):
+        """A collection can trigger at any allocation, so the hook can
+        preempt a thread already inside ``metrics.snapshot()`` — it must
+        finish WITHOUT taking the registry lock (the old direct
+        ``observe()`` self-deadlocked a live worker's Status thread),
+        deferring the histogram rows to the next tick's flush."""
+        p = ContinuousProfiler(10.0)
+        p.install_gc()
+        try:
+            with live_metrics._lock:  # what snapshot() holds
+                gc.collect()          # old code: deadlocks right here
+            p.sample_once(cost=0.0, stacks=[])  # drains deferred rows
+            snap = live_metrics.snapshot()
+            pause = series_map(snap, "gol_gc_pause_seconds")
+            assert pause and pause[()]["count"] >= 1
+        finally:
+            p.remove_gc()
+
+    def test_gc_pause_rule_in_default_book(self):
+        from gol_distributed_final_tpu.obs.slo import (
+            DEFAULT_RULE_NAMES,
+            default_rules,
+        )
+
+        assert "gc-pause" in DEFAULT_RULE_NAMES
+        rule = next(r for r in default_rules() if r.name == "gc-pause")
+        assert rule.metric == "gol_gc_pause_seconds"
+
+
+# -- the module-global lifecycle ----------------------------------------------
+
+
+class TestModuleLifecycle:
+    def test_enable_disable(self, tmp_path):
+        before = len(gc.callbacks)
+        p = obs_profiler.enable(
+            period_ms=50.0, out_dir=str(tmp_path), tag="t",
+            start_thread=False,
+        )
+        try:
+            assert obs_profiler.enabled() and obs_profiler.profiler() is p
+            assert len(gc.callbacks) == before + 1  # track_gc default on
+            assert obs_metrics.registry() is not None
+            p.sample_once(cost=0.0, stacks=[("main", _stack("a"))])
+            assert obs_profiler.window(0)["stacks"] == 1
+            assert len(obs_profiler.summary()["frames"]) == 1
+        finally:
+            obs_profiler.disable()
+        assert not obs_profiler.enabled()
+        assert len(gc.callbacks) == before  # the pairing hygiene enforces
+        assert obs_profiler.window() is None
+        assert obs_profiler.summary() is None
+        obs_metrics.enable(False)
+        obs_metrics.registry().reset()
+
+    def test_shutdown_writes_run_end_artifacts(self, tmp_path):
+        p = obs_profiler.enable(
+            period_ms=50.0, out_dir=str(tmp_path), tag="end",
+            track_gc=False, start_thread=False,
+        )
+        p.sample_once(cost=0.0, stacks=[("main", _stack("a"))])
+        obs_profiler.shutdown()
+        assert (tmp_path / "profile_end.collapsed").exists()
+        assert (tmp_path / "profile_end.speedscope.json").exists()
+        obs_profiler.shutdown()  # disabled: a no-op, never a raise
+        obs_metrics.enable(False)
+        obs_metrics.registry().reset()
+
+    def test_flush_on_crash_never_raises(self, tmp_path):
+        p = obs_profiler.enable(
+            period_ms=50.0, out_dir=str(tmp_path), tag="t",
+            track_gc=False, start_thread=False,
+        )
+        p.sample_once(cost=0.0, stacks=[("main", _stack("a"))])
+        obs_profiler.flush_on_crash(ValueError("boom"))
+        assert (tmp_path / "profile_crash_t.collapsed").exists()
+        obs_profiler.disable()
+        obs_profiler.flush_on_crash(ValueError("boom"))  # off: no-op
+        obs_metrics.enable(False)
+        obs_metrics.registry().reset()
+
+    def test_daemon_thread_samples_on_its_own(self, tmp_path):
+        obs_profiler.enable(
+            period_ms=2.0, out_dir=str(tmp_path), tag="t",
+            track_gc=False,
+        )
+        try:
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                w = obs_profiler.window(0)
+                if w and w["stacks"] >= 5:
+                    break
+                time.sleep(0.01)
+            else:
+                pytest.fail("daemon sampler never folded a stack")
+        finally:
+            obs_profiler.disable()
+            obs_metrics.enable(False)
+            obs_metrics.registry().reset()
+
+
+# -- Status integration: the skew-safe profile_since round-trip ---------------
+
+
+class TestStatusWindow:
+    def test_status_payload_embeds_incremental_window(self, live_metrics):
+        from gol_distributed_final_tpu.obs.report import status_payload
+
+        p = obs_profiler.enable(period_ms=50.0, track_gc=False,
+                                start_thread=False)
+        p.sample_once(cost=0.0, stacks=[("main", _stack("a"))])
+        payload = status_payload(role="test", profile_since=0)
+        assert payload["profile"]["frames"][0]["func"] == "a"
+        seq = payload["profile"]["seq"]
+        again = status_payload(role="test", profile_since=seq)
+        assert again["profile"]["frames"] == []  # nothing moved since
+        obs_profiler.disable()
+        assert "profile" not in status_payload(role="test", profile_since=0)
+
+    def test_old_pickle_without_profile_since_gets_full_window(self):
+        """A Request unpickled from a pre-profiler peer has NO
+        profile_since attribute — the handlers' getattr posture must
+        read it as 0 (the full window), never raise."""
+        from gol_distributed_final_tpu.rpc.protocol import Request
+
+        req = Request()
+        assert req.profile_since == 0  # current default asks for all
+        old = Request()
+        del old.profile_since  # the old-pickle shape: field absent
+        psince = getattr(old, "profile_since", 0)
+        assert psince == 0
+
+    def test_watch_profile_panel_pure_render(self):
+        from gol_distributed_final_tpu.obs.watch import _profile_lines
+
+        payload = {"profile": {
+            "seq": 7, "stacks": 100, "period_ms": 10.0, "backoffs": 1,
+            "gc": {"pauses": 2, "pause_s": 0.01, "max_pause_s": 0.008},
+            "frames": [
+                {"func": "wait", "file": "threading.py", "line": 1,
+                 "self": 60, "cum": 60},
+                {"func": "hot", "file": "pkg/h.py", "line": 3,
+                 "self": 30, "cum": 40},
+            ],
+        }}
+        lines = _profile_lines(payload)
+        assert "PROFILE" in lines[0] and "backoff" in lines[0]
+        assert any("gc: 2 pause(s)" in l for l in lines)
+        body = "\n".join(lines)
+        assert "hot" in body and "wait" not in body  # busy view only
+        assert _profile_lines({"metrics": {}}) == []  # no window: no panel
+
+
+# -- doctor: the hotspot join -------------------------------------------------
+
+
+def _hot_status(frames, stacks=100, hot_stacks=(), metrics=None):
+    return {"worker 127.0.0.1:9999": {
+        "pid": 1, "role": "worker", "metrics_enabled": True,
+        "metrics": metrics or {},
+        "profile": {
+            "schema": "gol-profile/1", "seq": 50, "stacks": stacks,
+            "period_ms": 10.0, "frames": frames,
+            "hot_stacks": list(hot_stacks),
+        },
+    }}
+
+
+class TestDoctorHotspot:
+    def test_hotspot_named_from_profile_window(self):
+        from gol_distributed_final_tpu.obs.doctor import diagnose
+
+        statuses = _hot_status(
+            [
+                {"func": "wait", "file": "threading.py", "line": 1,
+                 "self": 500, "cum": 500},  # parked: excluded
+                {"func": "serialize", "file": "rpc/protocol.py", "line": 9,
+                 "self": 60, "cum": 80},
+                {"func": "misc", "file": "pkg/m.py", "line": 2,
+                 "self": 10, "cum": 10},
+            ],
+            hot_stacks=[{"stack": "main;run;serialize (rpc/protocol.py:9)",
+                         "self": 60}],
+        )
+        findings = diagnose(statuses)
+        hot = next(f for f in findings if f["title"].startswith("hotspot"))
+        assert "serialize" in hot["title"] and "86%" in hot["title"]
+        assert any("hot path" in e for e in hot["evidence"])
+        assert "flame -diff" in hot["detail"]
+
+    def test_hotspot_joins_segment_decomposition(self, monkeypatch):
+        from gol_distributed_final_tpu.obs import doctor as obs_doctor
+        from gol_distributed_final_tpu.obs import perf as obs_perf
+
+        monkeypatch.setattr(
+            obs_perf, "decomposition_summary",
+            lambda snap: {"broker": {
+                "host_prep": {"share": 0.58, "seconds": 1.0},
+                "_total": {"share": 1.0},
+            }},
+        )
+        statuses = _hot_status([
+            {"func": "dumps", "file": "rpc/protocol.py", "line": 9,
+             "self": 71, "cum": 71},
+        ])
+        hot = next(
+            f for f in obs_doctor.diagnose(statuses)
+            if f["title"].startswith("hotspot")
+        )
+        assert "host_prep" in hot["detail"] and "58%" in hot["detail"]
+        assert any("gol_turn_segment_seconds" in e for e in hot["evidence"])
+
+    def test_no_hotspot_below_concentration_or_sample_floor(self):
+        from gol_distributed_final_tpu.obs.doctor import diagnose
+
+        spread = _hot_status([
+            {"func": f"f{i}", "file": "pkg/m.py", "line": i,
+             "self": 20, "cum": 20} for i in range(5)
+        ])  # top busy share 0.2 < 0.25
+        assert not any(
+            f["title"].startswith("hotspot") for f in diagnose(spread)
+        )
+        few = _hot_status(
+            [{"func": "hot", "file": "pkg/h.py", "line": 1,
+              "self": 10, "cum": 10}],
+            stacks=10,  # below the 20-stack honesty floor
+        )
+        assert not any(
+            f["title"].startswith("hotspot") for f in diagnose(few)
+        )
+
+    def test_all_idle_profile_yields_no_hotspot(self):
+        from gol_distributed_final_tpu.obs.doctor import diagnose
+
+        parked = _hot_status([
+            {"func": "wait", "file": "threading.py", "line": 1,
+             "self": 900, "cum": 900},
+            {"func": "select", "file": "selectors.py", "line": 1,
+             "self": 100, "cum": 100},
+        ], stacks=1000)
+        assert not any(
+            f["title"].startswith("hotspot") for f in diagnose(parked)
+        )
+
+
+# -- bundle: profile artifacts + uniform dropped stamps -----------------------
+
+
+class TestBundleProfiles:
+    def test_bundle_collects_profiles_and_stamps_caps(self, tmp_path):
+        from gol_distributed_final_tpu.obs.doctor import write_bundle
+
+        for i in range(8):  # two past the keep=6 cap
+            f = tmp_path / f"profile_w{i}.collapsed"
+            f.write_text("main;a (pkg/a.py:1) 1\n")
+            mtime = time.time() - (8 - i) * 10
+            os.utime(f, (mtime, mtime))
+        (tmp_path / "profile_w0.speedscope.json").write_text("{}")
+        bdir = write_bundle([], {}, out_dir=str(tmp_path))
+        manifest = json.loads((bdir / "manifest.json").read_text())
+        copied = {e["file"] for e in manifest["entries"]}
+        assert "profile_w7.collapsed" in copied  # newest kept
+        assert "profile_w0.speedscope.json" in copied
+        dropped = [
+            d for d in manifest["dropped"] if d["kind"] == "profile"
+        ]
+        assert {d["file"] for d in dropped} == {
+            "profile_w0.collapsed", "profile_w1.collapsed",
+        }
+        # every dropped entry carries the uniform shape: file/kind/why
+        assert all(set(d) == {"file", "kind", "why"}
+                   for d in manifest["dropped"])
+
+
+# -- regress: the cross-round profile gates -----------------------------------
+
+
+class TestRegressProfileGate:
+    def test_overhead_gate(self):
+        from gol_distributed_final_tpu.obs.regress import _apply_profile_gate
+
+        out = _apply_profile_gate(
+            {"profile_overhead_pct": 1.0}, {"profile_overhead_pct": 9.0},
+            {"verdict": "OK"}, 0.05,
+        )
+        assert out["verdict"] == "REGRESSED"
+        assert out["profile_overhead_delta_pts"] == pytest.approx(8.0)
+        ok = _apply_profile_gate(
+            {"profile_overhead_pct": 1.0}, {"profile_overhead_pct": 2.0},
+            {"verdict": "OK"}, 0.05,
+        )
+        assert ok["verdict"] == "OK"  # 1pt < the 5pt threshold
+
+    def test_hot_frame_mover_gate(self):
+        from gol_distributed_final_tpu.obs.regress import _apply_profile_gate
+
+        old = {"profile_hot": [{"frame": "a", "self_share": 0.10}]}
+        new = {"profile_hot": [{"frame": "a", "self_share": 0.60}]}
+        out = _apply_profile_gate(old, new, {"verdict": "OK"}, 0.05)
+        assert out["verdict"] == "REGRESSED"
+        assert out["profile_top_mover"] == "a"
+        mild = _apply_profile_gate(
+            old,
+            {"profile_hot": [{"frame": "a", "self_share": 0.30}]},
+            {"verdict": "OK"}, 0.05,
+        )
+        assert mild["verdict"] == "OK"  # reported, not gated
+        assert mild["profile_top_mover_delta_share"] == pytest.approx(0.2)
+
+    def test_compare_case_carries_profile_gate(self):
+        from gol_distributed_final_tpu.obs.regress import compare_case
+
+        old = {"per_turn_us": 10.0, "spread_s": 0.0, "n_hi": 2, "n_lo": 1,
+               "profile_overhead_pct": 1.0}
+        new = {"per_turn_us": 10.0, "spread_s": 0.0, "n_hi": 2, "n_lo": 1,
+               "profile_overhead_pct": 50.0}
+        out = compare_case(old, new, threshold=0.05)
+        assert out["verdict"] == "REGRESSED"
+        assert "profiler overhead" in out["why"]
+        # the incomparable path (broken fit) still runs the profile gate
+        broken = compare_case(
+            {"profile_overhead_pct": 1.0},
+            {"profile_overhead_pct": 50.0},
+            threshold=0.05,
+        )
+        assert broken["verdict"] == "REGRESSED"
+
+
+# -- history: HLC time-window flags -------------------------------------------
+
+
+class TestHistoryWindow:
+    def test_matches_since_until_inclusive(self):
+        from gol_distributed_final_tpu.obs.history import _matches
+
+        ev = {"kind": "x", "hlc": [1000, 0, "n1"]}
+        assert _matches(ev, None, None, since_ms=500, until_ms=1500)
+        assert _matches(ev, None, None, since_ms=1000, until_ms=1000)
+        assert not _matches(ev, None, None, since_ms=1001, until_ms=None)
+        assert not _matches(ev, None, None, since_ms=None, until_ms=999)
+        # no usable stamp: physical falls back to 0 — survives only an
+        # unbounded-below window
+        unstamped = {"kind": "x"}
+        assert _matches(unstamped, None, None, since_ms=None, until_ms=50)
+        assert not _matches(unstamped, None, None, since_ms=1, until_ms=None)
+
+    def test_build_history_records_window_filters(self, tmp_path):
+        from gol_distributed_final_tpu.obs.history import build_history
+
+        doc = build_history(
+            "t", out_dir=str(tmp_path), brokers=[], workers=[],
+            since_ms=5, until_ms=9,
+        )
+        assert doc["filters"]["since_ms"] == 5
+        assert doc["filters"]["until_ms"] == 9
+        assert doc["events"] == []
+
+    @staticmethod
+    def _write_segment(tmp_path):
+        from gol_distributed_final_tpu.obs import journal as obs_journal
+
+        seg = tmp_path / "journal_test_123.jsonl"
+        events = [
+            {"schema": obs_journal.SCHEMA, "kind": "worker.lost",
+             "name": "w1", "seq": i + 1,
+             "hlc": [1000 * (i + 1), 0, "test-node"]}
+            for i in range(3)  # physical stamps 1000, 2000, 3000
+        ]
+        seg.write_bytes(b"".join(
+            obs_journal._frame(json.dumps(e).encode()) for e in events
+        ))
+        return seg
+
+    def test_build_history_windows_segment_events(self, tmp_path):
+        from gol_distributed_final_tpu.obs.history import build_history
+
+        self._write_segment(tmp_path)
+        doc = build_history("t", out_dir=str(tmp_path), brokers=[],
+                            workers=[], since_ms=1500, until_ms=2500)
+        assert [e["seq"] for e in doc["events"]] == [2]
+        unbounded = build_history("t", out_dir=str(tmp_path))
+        assert [e["seq"] for e in unbounded["events"]] == [1, 2, 3]
+
+    def test_cli_flags_window_the_artifact(self, tmp_path, capsys):
+        from gol_distributed_final_tpu.obs import history
+
+        self._write_segment(tmp_path)
+        rc = history.main([
+            "t", "-dir", str(tmp_path), "-since", "1500", "-until", "2500",
+        ])
+        assert rc == 0
+        capsys.readouterr()
+        doc = json.loads((tmp_path / "history_t.json").read_text())
+        assert doc["filters"]["since_ms"] == 1500
+        assert doc["filters"]["until_ms"] == 2500
+        assert doc["events_total"] == 1
+
+
+# -- hygiene: the gc-callback registration checker ----------------------------
+
+
+class TestHygieneGcCallbacks:
+    def test_append_without_remove_flagged(self):
+        from gol_distributed_final_tpu.analysis.hygiene import HygieneChecker
+
+        from test_analysis import findings_for
+
+        found = findings_for(HygieneChecker(), """
+            import gc
+
+            def install(cb):
+                gc.callbacks.append(cb)
+        """)
+        assert len(found) == 1
+        assert "gc.callbacks.append" in found[0].message
+
+    def test_append_with_remove_anywhere_in_file_ok(self):
+        from gol_distributed_final_tpu.analysis.hygiene import HygieneChecker
+
+        from test_analysis import findings_for
+
+        found = findings_for(HygieneChecker(), """
+            import gc
+
+            def install(cb):
+                gc.callbacks.append(cb)
+
+            def uninstall(cb):
+                gc.callbacks.remove(cb)
+        """)
+        assert found == []
+
+
+# -- lint: the README Profiling section ---------------------------------------
+
+
+def test_profiler_names_documented(repo_root):
+    from gol_distributed_final_tpu.obs.lint import (
+        _PROFILER_DOC_NAMES,
+        undocumented_profiler_names,
+    )
+
+    assert "gol_gc_pause_seconds" in _PROFILER_DOC_NAMES
+    assert undocumented_profiler_names() == []
+
+
+# -- live: cross-process profile polls + the slow-worker drill ----------------
+
+
+def _spawn_worker(extra_args=(), extra_env=None):
+    env = dict(os.environ)
+    env.pop("GOL_FAULT_POINTS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    # conftest pins THIS process to 8 virtual CPU devices via XLA_FLAGS,
+    # which the child would inherit — an 8-device jax init in every
+    # worker is seconds of import/compile churn that starves the 5ms
+    # sampler and can stall Status past its timeout on a loaded runner.
+    # A strip worker needs exactly one device.
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    if extra_env:
+        env.update(extra_env)
+    return subprocess.Popen(
+        [sys.executable, "-m", "gol_distributed_final_tpu.rpc.worker",
+         "-port", "0", *extra_args],
+        cwd=REPO_ROOT, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True,
+    )
+
+
+def _wait_port(proc, timeout=60):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if "listening on :" in line:
+            return int(line.rsplit(":", 1)[1].split()[0])
+        if proc.poll() is not None:
+            raise RuntimeError(f"worker died: {proc.stdout.read()}")
+    raise TimeoutError("worker did not report listening")
+
+
+def _kill(procs):
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+        p.wait()
+
+
+def test_live_profile_window_over_status(live_metrics):
+    """A ``-profile`` worker ships an incremental profile window over the
+    real Status surface; an echoed far-future seq ships zero frames."""
+    from gol_distributed_final_tpu.obs.status import fetch_status
+
+    w = _spawn_worker(extra_args=("-profile", "5"))
+    try:
+        port = _wait_port(w)
+        addr = f"127.0.0.1:{port}"
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            payload = fetch_status(addr, worker=True, profile_since=0)
+            pw = payload.get("profile")
+            if pw and pw.get("stacks", 0) >= 5:
+                break
+            time.sleep(0.1)
+        else:
+            pytest.fail("worker never shipped a populated profile window")
+        assert pw["schema"] == "gol-profile/1"
+        assert pw["period_ms"] > 0 and pw["frames"]
+        # the incremental contract: nothing can have moved past a seq
+        # far beyond the sampler's own
+        later = fetch_status(
+            addr, worker=True, profile_since=pw["seq"] + 10 ** 9
+        )
+        assert later["profile"]["frames"] == []
+        assert later["profile"]["stacks"] >= pw["stacks"]
+    finally:
+        _kill([w])
+
+
+def test_live_drill_doctor_names_slowed_site_and_flame_diffs_it(
+    live_metrics,
+):
+    """THE acceptance drill: a sleep-slowed worker (GOL_FAULT_POINTS on
+    its strip_step/update sites) in a live 2-worker resident cluster,
+    both workers under ``-profile``. One Status poll later the doctor's
+    hotspot finding names the slowed site's function (``fault_point`` —
+    the Python frame that owns the injected sleep), and the flame diff
+    of slow-vs-clean flags that frame as the top mover."""
+    from gol_distributed_final_tpu.obs.doctor import collect, diagnose
+    from gol_distributed_final_tpu.rpc.broker import serve
+    from gol_distributed_final_tpu.rpc.client import RpcClient
+    from gol_distributed_final_tpu.rpc.protocol import Methods, Request
+
+    slow_env = {
+        # one StripStep RPC per K-batch: turns=24 / halo_depth=4 -> 6
+        # batches -> ~1.5s parked inside fault_point, the sampled leaf
+        "GOL_FAULT_POINTS":
+            "worker.strip_step:sleep:1:0.25,worker.update:sleep:1:0.25"
+    }
+    workers = [
+        _spawn_worker(extra_args=("-profile", "5"),
+                      extra_env=slow_env if i == 0 else None)
+        for i in range(2)
+    ]
+    server = None
+    try:
+        ports = [_wait_port(w) for w in workers]
+        slow_addr, clean_addr = (f"127.0.0.1:{p}" for p in ports)
+        server, service = serve(
+            port=0, backend="workers",
+            worker_addresses=[slow_addr, clean_addr],
+            wire="resident", halo_depth=4,
+        )
+        addr = f"127.0.0.1:{server.port}"
+        rng = np.random.default_rng(11)
+        board = np.where(rng.random((64, 64)) < 0.3, 255, 0).astype(np.uint8)
+        client = RpcClient(addr)
+        try:
+            client.call(
+                Methods.BROKER_RUN,
+                Request(world=board, turns=24, threads=4,
+                        image_width=64, image_height=64),
+                timeout=120.0,
+            )
+        finally:
+            client.close()
+        # ONE doctor poll over the real Status surface names the site.
+        # The samples backing it are cumulative, so on a loaded runner a
+        # poll that lands before the samplers drained the sleep window
+        # is simply retried — each iteration is still a single poll.
+        deadline = time.monotonic() + 60.0
+        while True:
+            statuses = collect(addr, [slow_addr, clean_addr], timeout=30.0)
+            findings = diagnose(statuses)
+            hot = [f for f in findings if f["title"].startswith("hotspot")]
+            if any("fault_point" in f["title"] for f in hot):
+                break
+            assert time.monotonic() < deadline, [
+                (f["title"], f["evidence"]) for f in findings
+            ]
+            time.sleep(0.5)
+        named = next(f for f in hot if "fault_point" in f["title"])
+        assert any("rpc/faults.py" in e for e in named["evidence"])
+        # the flame diff, clean -> slow: the injected frame is the top
+        # active mover by self-share
+        clean = flame.load_live(clean_addr, worker=True, timeout=30.0)
+        slow = flame.load_live(slow_addr, worker=True, timeout=30.0)
+        movers = flame.diff_profiles(clean, slow, active_only=True)
+        assert movers, "no mover past the noise floor"
+        assert "fault_point" in movers[0]["frame"], movers[:5]
+    finally:
+        if server is not None:
+            service.backend.close()
+            server.stop()
+        _kill(workers)
