@@ -218,9 +218,11 @@ def _bench_wire_modes(extra: dict) -> int:
     deterministic, unlike loopback timing."""
     import shutil
     import tempfile
+    import threading
 
     import numpy as np
 
+    from gol_distributed_final_tpu.obs import fleet as obs_fleet
     from gol_distributed_final_tpu.obs import journal as obs_journal
     from gol_distributed_final_tpu.obs import metrics as obs_metrics
     from gol_distributed_final_tpu.obs import perf as obs_perf
@@ -245,33 +247,33 @@ def _bench_wire_modes(extra: dict) -> int:
     want100 = None  # cross-mode parity reference (100 turns)
     jdir = tempfile.mkdtemp(prefix="gol_bench_journal_")
     try:
-        for wire, k, key, n_lo, n_hi, check, timeline, attribution, journal, profile in (
-            ("full", 1, "c7_wire_full", 30, 230, True, False, True, False, False),
-            ("haloed", 1, "c7_wire_haloed", 30, 230, True, False, True, False, False),
+        for wire, k, key, n_lo, n_hi, check, timeline, attribution, journal, profile, fleet in (
+            ("full", 1, "c7_wire_full", 30, 230, True, False, True, False, False, False),
+            ("haloed", 1, "c7_wire_haloed", 30, 230, True, False, True, False, False, False),
             # resident turns are much cheaper per RPC: wider endpoints so
             # the marginal work still dominates loopback timing noise
-            ("resident", 1, "c7_wire_resident_k1", 100, 1100, True, False, True, False, False),
-            ("resident", 8, "c7_wire_resident_k8", 100, 1100, True, False, True, False, False),
+            ("resident", 1, "c7_wire_resident_k1", 100, 1100, True, False, True, False, False, False),
+            ("resident", 8, "c7_wire_resident_k8", 100, 1100, True, False, True, False, False, False),
             # the same case UNDEFENDED (-integrity off, both sides): the
             # checked case above pays the in-header frame crcs + adler32
             # attestations, so the pair prices the integrity layer — the
             # overhead gate below holds it under 3% of resident turn cost
-            ("resident", 8, "c7_wire_resident_k8_nock", 100, 1100, False, False, True, False, False),
+            ("resident", 8, "c7_wire_resident_k8_nock", 100, 1100, False, False, True, False, False, False),
             # the same case with the -timeline sampler ON (1 s cadence,
             # the serving default): prices the always-on history + SLO
             # evaluation; the overhead gate below holds it under 2%
-            ("resident", 8, "c7_wire_resident_k8_timeline", 100, 1100, True, True, True, False, False),
+            ("resident", 8, "c7_wire_resident_k8_timeline", 100, 1100, True, True, True, False, False, False),
             # the same case with the dispatch-wall decomposition + the
             # critical-path attribution OFF (obs/perf.set_attribution):
             # the on-vs-off pair prices the WHERE-TIME-GOES layer; the
             # overhead gate below holds it under 2%
-            ("resident", 8, "c7_wire_resident_k8_noattr", 100, 1100, True, False, False, False, False),
+            ("resident", 8, "c7_wire_resident_k8_noattr", 100, 1100, True, False, False, False, False, False),
             # the same case with the durable lifecycle journal ON
             # (obs/journal.py: hot-path record() calls + the buffered
             # segment writer, flushing to a throwaway dir): prices the
             # "-journal in production" story; the overhead gate below
             # holds it under 2% of resident turn cost
-            ("resident", 8, "c7_wire_resident_k8_journal", 100, 1100, True, False, True, True, False),
+            ("resident", 8, "c7_wire_resident_k8_journal", 100, 1100, True, False, True, True, False, False),
             # the same case with the continuous sampling profiler ON
             # (obs/profiler.py: 10 ms wall-clock stack sampling + GC
             # pause metering, adaptive backoff armed): prices the
@@ -279,7 +281,18 @@ def _bench_wire_modes(extra: dict) -> int:
             # holds it under 2% of resident turn cost, and the case
             # embeds the sampled hot-frame table for regress's
             # cross-round top-mover gate
-            ("resident", 8, "c7_wire_resident_k8_profile", 100, 1100, True, False, True, False, True),
+            ("resident", 8, "c7_wire_resident_k8_profile", 100, 1100, True, False, True, False, True, False),
+            # the same case SCRAPED: a FleetCollector sweeping all 4
+            # workers' Status endpoints at a 1 s cadence (5x the 5 s
+            # production default) from a background thread (obs/fleet.py
+            # — parallel fan-out, exact registry merge, fleet gauges)
+            # while the data plane runs. The on-vs-off pair prices "a
+            # collector is watching" for the serving story; the overhead
+            # gate below holds the scrape tax under 2% of resident turn
+            # cost, and the case embeds fleet_scrape_p99_us (p99 of
+            # gol_fleet_scrape_seconds over the run) for regress's
+            # cross-round gate
+            ("resident", 8, "c7_wire_resident_k8_fleet", 100, 1100, True, False, True, False, False, True),
         ):
             _integrity.set_enabled(check)
             obs_perf.set_attribution(attribution)
@@ -289,6 +302,29 @@ def _bench_wire_modes(extra: dict) -> int:
                 obs_journal.enable(out_dir=jdir, role="bench")
             if profile:
                 obs_profiler.enable(period_ms=10.0, out_dir=jdir, tag="bench")
+            collector = scrape_stop = scrape_thread = None
+            if fleet:
+                # the collector scrapes the four workers directly (no
+                # broker in this loopback rig). 1 s cadence: aggressive
+                # (5x the production default) but honest — every scrape
+                # serve + the whole-registry merge runs IN this process,
+                # so a saturating cadence would price GIL contention the
+                # deployment never sees, not the collector
+                collector = obs_fleet.FleetCollector(
+                    [], extra_workers=addrs, interval=1.0, timeout=5.0
+                )
+                scrape_stop = threading.Event()
+
+                def _scrape_loop(c=collector, stop=scrape_stop):
+                    while not stop.is_set():
+                        c.sweep()
+                        stop.wait(1.0)
+
+                scrape_thread = threading.Thread(
+                    target=_scrape_loop, name="bench-fleet-scrape",
+                    daemon=True,
+                )
+                scrape_thread.start()
             backend = WorkersBackend(addrs, wire=wire, halo_depth=k)
             try:
                 def evolve(n, backend=backend):
@@ -341,7 +377,26 @@ def _bench_wire_modes(extra: dict) -> int:
                         for r in frames[:5]
                     ] if busy_total else []
                     extra[key]["profile_samples"] = ps.get("stacks", 0)
+                if fleet:
+                    # embed the sweep-latency p99 (µs) from the
+                    # gol_fleet_scrape_seconds histogram — the scrape
+                    # plane's own cost, priced beside the data-plane tax
+                    for fam in obs_metrics.registry().snapshot()["families"]:
+                        if fam["name"] != "gol_fleet_scrape_seconds":
+                            continue
+                        for s in fam["series"]:
+                            p99 = obs_timeline.quantile_from_buckets(
+                                tuple(fam["le"]), s["buckets"], 0.99
+                            )
+                            if p99 is not None:
+                                extra[key]["fleet_scrape_p99_us"] = round(
+                                    p99 * 1e6, 1
+                                )
+                    extra[key]["fleet_sweeps"] = collector.sweeps
             finally:
+                if scrape_stop is not None:
+                    scrape_stop.set()
+                    scrape_thread.join(timeout=10.0)
                 backend.close()
                 if timeline:
                     obs_timeline.disable()
@@ -503,6 +558,37 @@ def _bench_wire_modes(extra: dict) -> int:
             f"off {pt_ck:.2f} ({profile_overhead_pct:+.1f}%, band "
             f"{2 * pr_noise_us:.2f} us; {pr.get('profile_samples', 0)} "
             f"stacks sampled)",
+            file=sys.stderr,
+        )
+        # fleet scrape-tax gate: collector-on vs collector-off resident
+        # K=8, the same noise-band posture — a FleetCollector hammering
+        # the workers' Status endpoints at a 10 ms cadence (parallel
+        # fan-out + exact registry merge per sweep) must cost the DATA
+        # PLANE under 2% of resident turn cost, or the "point a
+        # collector at production and leave it" story dies here. The
+        # embedded fleet_overhead_pct and fleet_scrape_p99_us ride into
+        # BENCH_r*.json so obs/regress.py gates the trajectory too.
+        fl = extra["c7_wire_resident_k8_fleet"]
+        pt_fl = fl["per_turn_us"]
+        fl_noise_us = sum(
+            c["spread_s"] / (c["n_hi"] - c["n_lo"]) * 1e6 for c in (ck, fl)
+        )
+        fleet_overhead_pct = (pt_fl - pt_ck) / pt_ck * 100.0
+        fl["fleet_overhead_pct"] = round(fleet_overhead_pct, 2)
+        if pt_fl - pt_ck > 0.02 * pt_ck + 2 * fl_noise_us:
+            print(
+                f"FLEET OVERHEAD GATE FAILURE: collector-on resident k8 "
+                f"{pt_fl:.2f} us/turn vs off {pt_ck:.2f} "
+                f"({fleet_overhead_pct:+.1f}%) exceeds 2% beyond the "
+                f"{fl_noise_us:.2f} us noise band",
+                file=sys.stderr,
+            )
+            return 1
+        print(
+            f"fleet overhead ok: collector on {pt_fl:.2f} us/turn vs "
+            f"off {pt_ck:.2f} ({fleet_overhead_pct:+.1f}%, band "
+            f"{2 * fl_noise_us:.2f} us; {fl.get('fleet_sweeps', 0)} "
+            f"sweeps, scrape p99 {fl.get('fleet_scrape_p99_us', 0)} us)",
             file=sys.stderr,
         )
     finally:

@@ -6,7 +6,10 @@
 
 PR 4/6 made failures *detectable* (worker_health, quarantine backoff,
 integrity counters, flight events); this CLI makes them *explained*: it
-pulls ``Status`` from the broker and any workers, correlates timelines,
+pulls ``Status`` from the broker and its workers (auto-discovered from
+the ``worker_health`` roster; ``-worker`` adds extras) — or from a
+fleet collector (obs/fleet.py), whose per-broker payloads are expanded
+and whose scrape health becomes findings — correlates timelines,
 flight rings, span statistics, worker health, and active SLO alerts into
 a ranked finding list ("worker :8041 quarantined 3x, resync counter
 climbing, wire bytes/turn 12x baseline -> suspect flapping transport"),
@@ -33,7 +36,7 @@ import sys
 import time
 from typing import Dict, List, Optional, Tuple
 
-from .status import StatusUnavailable, fetch_status
+from .status import fetch_many
 from .status import norm_address as _norm_addr
 from .status import scalar_value as _scalar
 from .status import series_map as _series_map
@@ -46,22 +49,59 @@ _SEVERITY_ORDER = {"page": 0, "warn": 1, "info": 2}
 def collect(
     broker: str, workers: List[str], timeout: float = 5.0
 ) -> Dict[str, dict]:
-    """One Status poll per target. Failed polls become ``{"error": ...}``
-    entries — a dead worker is EVIDENCE, not a fetch failure."""
+    """One PARALLEL Status poll per target (``status.fetch_many`` — a
+    wedged target costs one timeout, not the whole round). Failed polls
+    become ``{"error": ...}`` entries — a dead worker is EVIDENCE, not a
+    fetch failure.
+
+    Workers are auto-discovered from each broker payload's
+    ``worker_health`` roster (manual ``-worker`` flags stay additive
+    extras), and a fleet collector payload (obs/fleet.py,
+    ``role="fleet"``) is EXPANDED: every broker Status it scraped this
+    sweep is diagnosed as if polled directly, so one address triages the
+    whole cluster."""
+    specs: List[dict] = []
+    seen = set()
+    for addr, is_worker in [(broker, False)] + [(w, True) for w in workers]:
+        addr = _norm_addr(addr)
+        if addr not in seen:
+            seen.add(addr)
+            specs.append({"address": addr, "worker": is_worker})
+    results = fetch_many(specs, timeout=timeout)
+    discovered: List[dict] = []
+    for spec in specs:
+        payload = (results.get(spec["address"]) or (None,))[0]
+        if payload is None or spec["worker"]:
+            continue
+        for entry in payload.get("workers") or []:
+            if not isinstance(entry, dict):
+                continue
+            waddr = entry.get("address")
+            if not isinstance(waddr, str) or ":" not in waddr:
+                continue
+            waddr = _norm_addr(waddr)
+            if waddr not in seen:
+                seen.add(waddr)
+                discovered.append({"address": waddr, "worker": True})
+    if discovered:
+        results.update(fetch_many(discovered, timeout=timeout))
+        specs.extend(discovered)
     statuses: Dict[str, dict] = {}
-    targets = [(f"broker {_norm_addr(broker)}", _norm_addr(broker), False)]
-    targets += [
-        (f"worker {_norm_addr(w)}", _norm_addr(w), True) for w in workers
-    ]
-    for label, addr, is_worker in targets:
-        try:
-            statuses[label] = fetch_status(
-                addr, worker=is_worker, timeout=timeout
-            )
-        except StatusUnavailable as exc:
-            statuses[label] = {"error": f"no status: {exc}"}
-        except Exception as exc:
-            statuses[label] = {"error": f"poll failed: {exc}"}
+    for spec in specs:
+        addr = spec["address"]
+        kind = "worker" if spec["worker"] else "broker"
+        payload, _fetched_at, error = results.get(addr) or (
+            None, 0.0, "no result")
+        if error is not None:
+            statuses[f"{kind} {addr}"] = {"error": f"poll failed: {error}"}
+            continue
+        if payload.get("role") == "fleet":
+            statuses[f"fleet {addr}"] = payload
+            brokers = (payload.get("fleet") or {}).get("broker_status") or {}
+            for baddr in sorted(brokers):
+                statuses.setdefault(f"broker {baddr}", brokers[baddr])
+        else:
+            statuses[f"{kind} {addr}"] = payload
     return statuses
 
 
@@ -603,8 +643,161 @@ def _find_hotspot(statuses) -> List[dict]:
     return out
 
 
+def _find_fleet_targets(statuses) -> List[dict]:
+    """Fleet scrape-health findings (obs/fleet.py collector payloads): a
+    STALE target is a dead process named WITH its scrape evidence —
+    last-success age, consecutive-failure count, the last error string —
+    and a stale BROKER outranks every other page (a broker the fleet
+    lost is the first thing to fix). Failing-but-not-yet-stale targets
+    and merge-excluded (version-skewed) snapshots warn."""
+    out = []
+    for label, payload in statuses.items():
+        fl = payload.get("fleet")
+        if not isinstance(fl, dict):
+            continue
+        for t in fl.get("targets") or []:
+            state = t.get("state")
+            if state not in ("stale", "failing"):
+                continue
+            addr = str(t.get("address", "?"))
+            kind = "worker" if t.get("worker") else "broker"
+            fails = int(t.get("consecutive_failures") or 0)
+            age = t.get("last_success_age_s")
+            evidence = [
+                f"scrape health: {fails} consecutive failure(s), "
+                f"{int(t.get('ok_total') or 0)} ok / "
+                f"{int(t.get('err_total') or 0)} error(s) lifetime",
+                "last successful scrape: "
+                + (f"{age:.1f}s ago" if isinstance(age, (int, float))
+                   else "never"),
+            ]
+            if t.get("error"):
+                evidence.append(f"last scrape error: {t['error']}")
+            if state == "stale":
+                out.append(_finding(
+                    "page" if kind == "broker" else "warn",
+                    110.0 + fails,
+                    f"fleet target {kind} {addr} is DOWN (stale)",
+                    "no successful Status scrape past the staleness "
+                    f"bound ({fl.get('stale_after_s', '?')}s): its "
+                    "metrics left the merged registry (the fleet sums "
+                    "now cover the survivors only) and the "
+                    "'target-down' fleet rule pages on the "
+                    "gol_fleet_targets_down gauge.",
+                    evidence, [addr], label,
+                ))
+            else:
+                out.append(_finding(
+                    "warn", 58.0 + fails,
+                    f"fleet target {kind} {addr} failing scrapes",
+                    "recent scrapes failed but the last success is "
+                    "still inside the staleness bound — a blip, or the "
+                    "start of an outage.",
+                    evidence, [addr], label,
+                ))
+        for eaddr, why in sorted((fl.get("merge_excluded") or {}).items()):
+            out.append(_finding(
+                "warn", 57.0,
+                f"fleet target {eaddr} EXCLUDED from the merged registry",
+                "its snapshot could not be merged exactly (version skew "
+                "across the fleet); it was dropped and counted "
+                "(gol_fleet_merge_failures_total), never averaged in.",
+                [why], [eaddr], label,
+            ))
+    return out
+
+
+def _find_fleet_share(statuses) -> List[dict]:
+    """The cross-broker balance findings (fleet payloads only): one
+    broker holding a disproportionate share of the fleet's
+    device-seconds, and one tenant riding far past its fair share on a
+    single broker (the merged-ledger skew the gol_fleet_tenant_skew
+    gauge tracks)."""
+    out = []
+    for label, payload in statuses.items():
+        fl = payload.get("fleet")
+        if not isinstance(fl, dict):
+            continue
+        dev: Dict[str, float] = {}
+        for addr, bp in (fl.get("broker_status") or {}).items():
+            totals = (bp.get("accounting") or {}).get("totals") or {}
+            ds = totals.get("device_seconds")
+            if isinstance(ds, (int, float)) and ds > 0:
+                dev[addr] = float(ds)
+        if len(dev) >= 2:
+            total = sum(dev.values())
+            hot = max(dev, key=dev.get)
+            share = dev[hot] / total
+            if share > max(0.6, 2.0 / len(dev)):
+                out.append(_finding(
+                    "warn", 62.0 + 20.0 * share,
+                    f"broker {hot} holds {100 * share:.0f}% of fleet "
+                    "device-seconds",
+                    "the fleet's device time is concentrated on one "
+                    "broker while the rest idle — the load view the "
+                    "ROADMAP's session-router tier will route against.",
+                    [f"{a}: {v:.3f} dev-s ({100 * v / total:.0f}%)"
+                     for a, v in sorted(dev.items(), key=lambda kv: -kv[1])],
+                    [hot], label,
+                ))
+        sk = fl.get("tenant_skew") or {}
+        val = sk.get("value")
+        if isinstance(val, (int, float)) and val > 3.0:
+            out.append(_finding(
+                "warn", 61.0,
+                f"tenant '{sk.get('tenant')}' rides {val:.1f}x its fair "
+                f"share on broker {sk.get('address')}",
+                "cross-broker tenant skew from the merged ledgers: this "
+                "tenant's device-seconds pile onto one broker instead "
+                "of spreading — respread it, or the hot broker's "
+                "co-tenants pay its admission waits.",
+                [f"gol_fleet_tenant_skew = {val:.2f} "
+                 "(the fleet-tenant-skew rule warns past 3.0)"],
+                [str(sk.get("address"))], label,
+            ))
+    return out
+
+
+def _find_fleet_provenance(statuses) -> List[dict]:
+    """Divergent provenance across fleet targets: brokers that disagree
+    on the Status payload schema, the metrics snapshot schema, or the
+    backend class are running different code or config. Merged sums
+    stay exact either way, but cross-broker comparisons stop meaning
+    one thing — and schema skew is the usual root of a merge
+    exclusion."""
+    out = []
+    for label, payload in statuses.items():
+        fl = payload.get("fleet")
+        if not isinstance(fl, dict):
+            continue
+        brokers = fl.get("broker_status") or {}
+        if len(brokers) < 2:
+            continue
+        stamps = {
+            addr: (
+                str(bp.get("schema")),
+                str((bp.get("metrics") or {}).get("schema")),
+                str(bp.get("backend")),
+            )
+            for addr, bp in brokers.items()
+        }
+        if len(set(stamps.values())) > 1:
+            out.append(_finding(
+                "warn", 59.0,
+                "divergent provenance across fleet brokers",
+                "targets report different status/metrics schemas or "
+                "backend classes — a mixed-version or mixed-config "
+                "fleet.",
+                [f"{a}: status {s[0]}, metrics {s[1]}, backend {s[2]}"
+                 for a, s in sorted(stamps.items())],
+                sorted(stamps), label,
+            ))
+    return out
+
+
 _HEURISTICS = (
     _find_unreachable,
+    _find_fleet_targets,
     _find_lost_workers,
     _find_integrity,
     _find_alerts,
@@ -616,6 +809,8 @@ _HEURISTICS = (
     _find_checkpoint,
     _find_journal,
     _find_hotspot,
+    _find_fleet_share,
+    _find_fleet_provenance,
 )
 
 
@@ -891,7 +1086,8 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "-worker", action="append", default=[], metavar="HOST:PORT",
-        help="also poll and correlate this worker's Status (repeatable)",
+        help="extra worker to poll beyond the broker's worker_health "
+             "roster, which is auto-discovered (repeatable)",
     )
     parser.add_argument(
         "-timeout", type=float, default=5.0, metavar="SECONDS",

@@ -585,6 +585,88 @@ GC_COLLECTIONS_TOTAL = _R.counter(
     labelnames=("gen",),
 )
 
+# -- fleet collector (obs/fleet.py) -------------------------------------------
+# Published only inside the COLLECTOR process (its own registry, its own
+# Status verb): scrape health and merge cost of the control plane itself,
+# kept off every data-plane process's registry by construction — the
+# registrations below run lazily, on ``obs.fleet`` import, so a broker's
+# or worker's Status payload never carries the fleet families' (empty)
+# series and help text. The incremental-reply size budget
+# (tests/test_tenants.py) counts every registered family.
+
+FLEET_SCRAPES_TOTAL = None
+FLEET_TARGETS_TOTAL = None
+FLEET_TARGETS_DOWN = None
+FLEET_SCRAPE_SECONDS = None
+FLEET_MERGE_FAILURES_TOTAL = None
+FLEET_SESSIONS_ACTIVE = None
+FLEET_CAPACITY_TOTAL = None
+FLEET_TENANT_SKEW = None
+
+
+def register_fleet_instruments() -> None:
+    """Register the gol_fleet_* families (idempotent — the registry
+    refuses only signature CHANGES). obs/fleet.py calls this at import,
+    the only module that publishes these series."""
+    global FLEET_SCRAPES_TOTAL, FLEET_TARGETS_TOTAL, FLEET_TARGETS_DOWN
+    global FLEET_SCRAPE_SECONDS, FLEET_MERGE_FAILURES_TOTAL
+    global FLEET_SESSIONS_ACTIVE, FLEET_CAPACITY_TOTAL, FLEET_TENANT_SKEW
+    FLEET_SCRAPES_TOTAL = _R.counter(
+        "gol_fleet_scrapes_total",
+        "Per-target Status scrape attempts by the fleet collector "
+        "(obs/fleet.py), by outcome: 'ok' for a payload, 'error' for a "
+        "timeout/refused/skew failure. The error rate per address is the "
+        "scrape-health signal fleet doctor findings cite as evidence.",
+        labelnames=("outcome",),
+    )
+    FLEET_TARGETS_TOTAL = _R.gauge(
+        "gol_fleet_targets_total",
+        "Targets the collector currently scrapes (configured brokers plus "
+        "workers auto-discovered from their worker_health rosters).",
+    )
+    FLEET_TARGETS_DOWN = _R.gauge(
+        "gol_fleet_targets_down",
+        "Targets currently marked STALE: consecutive scrape failures pushed "
+        "the last-success age past the staleness bound (3 intervals). The "
+        "'target-down' fleet SLO rule pages on this going nonzero — a dead "
+        "broker is a first-class finding, not a timeout traceback.",
+    )
+    FLEET_SCRAPE_SECONDS = _R.histogram(
+        "gol_fleet_scrape_seconds",
+        "Wall seconds per fleet poll sweep (parallel fan-out across all "
+        "targets + exact merge + fleet timeline sample). bench.py embeds "
+        "its p99 as fleet_scrape_p99_us and gates the data-plane tax of "
+        "being scraped at <=2% beyond the noise band.",
+    )
+    FLEET_MERGE_FAILURES_TOTAL = _R.counter(
+        "gol_fleet_merge_failures_total",
+        "Target snapshots EXCLUDED from the merged cluster registry because "
+        "merge_snapshots refused them (type or histogram-edge mismatch — "
+        "version skew across the fleet). Skew degrades loudly, never "
+        "wrongly: the exactness contract means a non-mergeable snapshot is "
+        "dropped and counted, not averaged in.",
+    )
+    FLEET_SESSIONS_ACTIVE = _R.gauge(
+        "gol_fleet_sessions_active",
+        "Sum of gol_sessions_active across all live broker targets — the "
+        "numerator of the fleet capacity-headroom rule (denominator: summed "
+        "session_capacity from each broker's Status).",
+    )
+    FLEET_CAPACITY_TOTAL = _R.gauge(
+        "gol_fleet_capacity_total",
+        "Sum of session_capacity across all live broker targets. 0 while no "
+        "broker has reported (keeps the headroom rule silent rather than "
+        "dividing by a lie).",
+    )
+    FLEET_TENANT_SKEW = _R.gauge(
+        "gol_fleet_tenant_skew",
+        "Worst cross-broker tenant skew from the merged ledgers: for each "
+        "tenant, its hottest broker's share of that tenant's fleet "
+        "device-seconds, times the broker count (1.0 = perfectly spread, "
+        "N = all load on one broker). Only computed once >=2 brokers ship "
+        "ledgers; the 'fleet-tenant-skew' rule warns past 3x fair share.",
+    )
+
 # -- lock sanitizer (utils/locksan.py) ---------------------------------------
 
 LOCKSAN_VIOLATIONS_TOTAL = _R.counter(

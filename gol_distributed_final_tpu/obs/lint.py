@@ -106,6 +106,10 @@ _REQUIRED_SECTIONS = (
     # (collapsed + speedscope), flame diff semantics, and the GC pause
     # meter feeding the gc-pause SLO rule
     "## Profiling",
+    # the fleet-collector contract (obs/fleet.py): the collector CLI,
+    # scrape/staleness semantics, the fleet rule table, and the
+    # gol_fleet_* metric table
+    "## Fleet",
 )
 
 # the wire data-plane metric families (rpc/protocol.py frames + the
@@ -394,6 +398,38 @@ def undocumented_profiler_names(readme_path=None) -> List[str]:
     return sorted(n for n in _PROFILER_DOC_NAMES if n not in section)
 
 
+# the fleet-collector contract names (obs/fleet.py): the gol_fleet_*
+# metric families, the fleet SLO rule identities (obs/slo.py
+# FLEET_RULE_NAMES), and the collector's CLI/staleness knobs — these
+# must be documented in the README's "Fleet" section specifically, the
+# operator contract the collector's scrape/merge semantics are read
+# against
+_FLEET_DOC_NAMES = (
+    "gol_fleet_scrapes_total",
+    "gol_fleet_targets_total",
+    "gol_fleet_targets_down",
+    "gol_fleet_scrape_seconds",
+    "gol_fleet_merge_failures_total",
+    "gol_fleet_sessions_active",
+    "gol_fleet_capacity_total",
+    "gol_fleet_tenant_skew",
+    "target-down",
+    "fleet-capacity-headroom",
+    "fleet-tenant-skew",
+    "-interval",
+    "-port",
+)
+
+
+def undocumented_fleet_names(readme_path=None) -> List[str]:
+    """Fleet metric/rule/knob names missing from the README's "Fleet"
+    section specifically (the wire/device-table posture: a name
+    mentioned elsewhere in the file does not count as documented
+    here)."""
+    section = _readme_section(readme_path, "## Fleet")
+    return sorted(n for n in _FLEET_DOC_NAMES if n not in section)
+
+
 def undeclared_journal_kinds(readme_path=None, package_root=None) -> List[str]:
     """Registry drift between the journal's event-kind table and its
     emit sites: every literal kind passed to ``journal.record(...)``
@@ -553,6 +589,14 @@ CHECKS = (
         "section:",
         "profiler lint ok: every profiler metric and knob is in the "
         "Profiling section",
+    ),
+    (
+        "lint-fleet-metrics",
+        undocumented_fleet_names,
+        "fleet metric/rule/knob names missing from README.md's Fleet "
+        "section:",
+        "fleet lint ok: every fleet metric, rule, and knob is in the "
+        "Fleet section",
     ),
     (
         "lint-journal-kinds",

@@ -306,6 +306,20 @@ def merge_snapshots(a: dict, b: dict) -> dict:
     return out
 
 
+def merge_many(snaps) -> dict:
+    """Left fold of ``merge_snapshots`` over N per-process snapshots —
+    the fleet collector's cluster-registry primitive. The merged result
+    keeps the exactness contract: every counter equals the ARITHMETIC
+    SUM of its per-process values, every histogram bucket the per-bucket
+    sum. An empty iterable yields an empty snapshot; a single snapshot
+    comes back as a deep-ish copy (same shape as a merge result), so
+    callers may mutate it without aliasing a target's cached payload."""
+    merged = {"schema": "gol-metrics/1", "families": []}
+    for snap in snaps:
+        merged = merge_snapshots(merged, snap)
+    return merged
+
+
 def _copy_family(fam: dict) -> dict:
     out = {k: v for k, v in fam.items() if k != "series"}
     out["series"] = [dict(s, labels=list(s["labels"])) for s in fam["series"]]

@@ -195,6 +195,7 @@ def compare_case(
         out = _apply_fused_gate(old, new, out, threshold)
         out = _apply_journal_gate(old, new, out, threshold)
         out = _apply_profile_gate(old, new, out, threshold)
+        out = _apply_fleet_gate(old, new, out, threshold)
         return _apply_wire_bytes_gate(old, new, out, threshold)
     delta = new_us - old_us
     rel = delta / old_us
@@ -220,6 +221,7 @@ def compare_case(
     out = _apply_fused_gate(old, new, out, threshold)
     out = _apply_journal_gate(old, new, out, threshold)
     out = _apply_profile_gate(old, new, out, threshold)
+    out = _apply_fleet_gate(old, new, out, threshold)
     return _apply_wire_bytes_gate(old, new, out, threshold)
 
 
@@ -396,6 +398,40 @@ def _apply_profile_gate(
                 out["why"] = (
                     "the profile's dominant frame shifted between rounds"
                 )
+    return out
+
+
+def _apply_fleet_gate(
+    old: dict, new: dict, out: dict, threshold: float
+) -> dict:
+    """The fleet scrape-tax trajectory gate (ISSUE 18 satellite): the
+    wire bench's collector pair embeds ``fleet_overhead_pct``
+    (collector-on vs collector-off resident K=8, a FleetCollector
+    sweeping the workers at a 1 s cadence). bench.py's own run-time
+    gate holds each round under 2% beyond its noise band; THIS gate is
+    the cross-round backstop — the data-plane tax of being scraped
+    creeping up by more than ``100 * threshold`` percentage points
+    between rounds is REGRESSED even if a loosened per-round band let it
+    through (the journal/profiler gates' pattern, applied to the Status
+    serve path + the collector's fan-out). The embedded
+    ``fleet_scrape_p99_us`` (p99 of gol_fleet_scrape_seconds) rides
+    along as REPORTED context — sweep latency is the collector's own
+    cost, already bounded by its cadence, so it informs but never
+    gates."""
+    old_f, new_f = old.get("fleet_overhead_pct"), new.get("fleet_overhead_pct")
+    if old_f is not None and new_f is not None:
+        out["old_fleet_overhead_pct"] = old_f
+        out["new_fleet_overhead_pct"] = new_f
+        out["fleet_overhead_delta_pts"] = round(new_f - old_f, 2)
+        if new_f - old_f > 100.0 * threshold:
+            out["verdict"] = "REGRESSED"
+            out["why"] = (
+                "fleet scrape tax grew past the cross-round threshold"
+            )
+    old_p, new_p = old.get("fleet_scrape_p99_us"), new.get("fleet_scrape_p99_us")
+    if old_p is not None and new_p is not None:
+        out["old_fleet_scrape_p99_us"] = old_p
+        out["new_fleet_scrape_p99_us"] = new_p
     return out
 
 

@@ -215,6 +215,32 @@ class GaugeRatioRule(Rule):
         )
 
 
+class GaugeAboveRule(Rule):
+    """Fires when any label set's latest gauge value exceeds an absolute
+    ``threshold`` — the simplest possible bound, used where the gauge
+    itself already encodes the judgement (the fleet collector's
+    ``gol_fleet_targets_down`` count: ANY nonzero value is a dead
+    target)."""
+
+    def __init__(self, name, severity, metric, *, threshold=0.0):
+        super().__init__(name, severity)
+        self.metric = metric
+        self.threshold = threshold
+
+    def evaluate(self, tl):
+        vals = tl.gauge_values(self.metric)
+        worst, worst_labels = None, None
+        for labels, v in vals.items():
+            if worst is None or v > worst:
+                worst, worst_labels = v, labels
+        firing = worst is not None and worst > self.threshold
+        where = ",".join(worst_labels) if worst_labels else "-"
+        return firing, worst, (
+            f"{self.metric} {'?' if worst is None else f'{worst:.3g}'} "
+            f"at [{where}] (> {self.threshold:.3g})"
+        )
+
+
 class GrowthRule(Rule):
     """Fires when a gauge's latest value grew past ``factor x`` its
     value a window ago (both nonzero) — drift, not an absolute bound
@@ -334,6 +360,36 @@ def default_rules() -> List[Rule]:
     ]
 
 
+def fleet_rules() -> List[Rule]:
+    """Fleet-scope rules the collector (obs/fleet.py) adds ON TOP of the
+    re-instantiated default rulebook — each reads a ``gol_fleet_*`` gauge
+    the collector maintains in its OWN registry from scrape health and
+    the merged ledgers, so the rules ride the same timeline surface as
+    every other objective (names documented in the README "Fleet" rule
+    table, ``FLEET_RULE_NAMES`` below; obs/lint.py enforces the docs)."""
+    return [
+        # a target whose last-success age crossed the staleness bound is
+        # DOWN — the page every other fleet reading depends on, since a
+        # dead broker's sessions silently vanish from the merged sums
+        GaugeAboveRule(
+            "target-down", "page", "gol_fleet_targets_down", threshold=0.0,
+        ),
+        # summed live sessions vs summed broker capacity: past 90% the
+        # fleet has no room to reshard a dead broker's tenants into
+        GaugeRatioRule(
+            "fleet-capacity-headroom", "warn",
+            "gol_fleet_sessions_active", "gol_fleet_capacity_total",
+            max_ratio=0.90,
+        ),
+        # a tenant whose device-seconds pile onto ONE broker at >3x its
+        # fair share defeats the sharding the fleet exists to provide
+        GaugeAboveRule(
+            "fleet-tenant-skew", "warn", "gol_fleet_tenant_skew",
+            threshold=3.0,
+        ),
+    ]
+
+
 #: the stable rule-name contract (README "SLOs & alerting", obs/lint.py)
 DEFAULT_RULE_NAMES = (
     "worker-lost",
@@ -347,6 +403,13 @@ DEFAULT_RULE_NAMES = (
     "scatter-deadline-growth",
     "worker-skew",
     "gc-pause",
+)
+
+#: the fleet collector's rule-name contract (README "Fleet", obs/lint.py)
+FLEET_RULE_NAMES = (
+    "target-down",
+    "fleet-capacity-headroom",
+    "fleet-tenant-skew",
 )
 
 

@@ -80,6 +80,7 @@ def fetch_status(
     worker: bool = False,
     timeout: float = 10.0,
     timeline_since: int = 0,
+    accounting_since: int = 0,
     journal_since: int = 0,
     profile_since: int = 0,
 ) -> dict:
@@ -89,6 +90,8 @@ def fetch_status(
     (``payload["timeline"]["seq"]``) so a ``-timeline`` server ships
     only NEWER samples — the incremental-window contract; 0 asks for the
     full ring, and a pre-timeline server ignores the field entirely.
+    ``accounting_since`` is the tenant ledger's twin (broker only): a
+    ``-accounting`` broker ships only ledger deltas past this seq.
     ``journal_since`` is the lifecycle journal's twin (obs/journal.py):
     a ``-journal`` server ships only events past this seq.
     ``profile_since`` is the continuous profiler's twin
@@ -110,6 +113,7 @@ def fetch_status(
             Methods.WORKER_STATUS if worker else Methods.STATUS,
             Request(
                 timeline_since=timeline_since,
+                accounting_since=accounting_since,
                 journal_since=journal_since,
                 profile_since=profile_since,
             ),
@@ -118,6 +122,73 @@ def fetch_status(
     finally:
         client.close()
     return extract_status(res)
+
+
+def fetch_many(
+    targets,
+    timeout: float = 10.0,
+) -> Dict[str, tuple]:
+    """Parallel Status fan-out: one thread per target, each bounded by
+    its own ``timeout``, so a single wedged target costs ONE timeout
+    instead of stacking sequentially across the whole poll (the failure
+    mode a fleet-of-N collector cannot afford).
+
+    ``targets`` is an iterable of dicts, each at least
+    ``{"address": ...}`` plus optional ``worker`` (bool) and the four
+    ``*_since`` cursor fields — the same kwargs ``fetch_status`` takes.
+
+    Returns ``{address: (payload, fetched_at, error)}`` keyed by the
+    NORMALIZED address: exactly one of ``payload``/``error`` is non-None,
+    and ``fetched_at`` is the local wall clock at reply (or failure) —
+    the raw material for scrape-health bookkeeping (last-success age,
+    consecutive failures). Errors are captured as strings, never raised:
+    a dead target is DATA to a fleet consumer, not an exception."""
+    import threading
+    import time as _time
+
+    specs = []
+    for t in targets:
+        spec = dict(t)
+        spec["address"] = norm_address(spec["address"])
+        specs.append(spec)
+    results: Dict[str, tuple] = {}
+    lock = threading.Lock()
+
+    def one(spec: dict) -> None:
+        addr = spec["address"]
+        try:
+            payload = fetch_status(
+                addr,
+                worker=bool(spec.get("worker", False)),
+                timeout=timeout,
+                timeline_since=int(spec.get("timeline_since", 0)),
+                accounting_since=int(spec.get("accounting_since", 0)),
+                journal_since=int(spec.get("journal_since", 0)),
+                profile_since=int(spec.get("profile_since", 0)),
+            )
+            with lock:
+                results[addr] = (payload, _time.time(), None)
+        except Exception as exc:
+            with lock:
+                results[addr] = (None, _time.time(), str(exc) or type(exc).__name__)
+
+    threads = [
+        threading.Thread(target=one, args=(s,), daemon=True) for s in specs
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        # join is bounded: fetch_status itself times out, so a small
+        # grace on top covers thread scheduling, never a hung socket
+        th.join(timeout + 5.0)
+    with lock:
+        for s in specs:
+            # a thread that somehow outlived its bounded join still
+            # yields a result row — consumers never KeyError on a target
+            results.setdefault(
+                s["address"], (None, _time.time(), "fetch thread timed out")
+            )
+        return dict(results)
 
 
 def main(argv=None) -> int:

@@ -4,8 +4,9 @@
     python -m gol_distributed_final_tpu.obs.watch 10.0.0.2:8040 \\
         -worker 10.0.0.3:8030 -worker 10.0.0.4:8030 -interval 2
 
-Polls the broker's (and optionally each worker's) read-only ``Status``
-verb and renders a refreshing terminal panel: turn throughput, per-verb
+Polls the broker's read-only ``Status`` verb — workers are discovered
+from its ``worker_health`` roster automatically; ``-worker`` adds
+extras — and renders a refreshing terminal panel: turn throughput, per-verb
 RPC latency, compile-cache hit rate + kernel cost analysis, per-device
 HBM occupancy, and the flight-recorder tail. Built ENTIRELY on the Status
 surface — the dashboard can be attached to and detached from a live run
@@ -30,7 +31,7 @@ import sys
 import time
 from typing import Dict, List, Optional, Tuple
 
-from .status import StatusUnavailable, fetch_status
+from .status import fetch_many, norm_address
 from .status import scalar_value as _scalar
 from .status import series_map as _series_map
 from .timeline import counter_delta
@@ -93,6 +94,68 @@ def _alert_lines(payload: dict) -> List[str]:
             f"  ** {str(a.get('severity', '?')).upper():<4} "
             f"{a.get('rule', '?'):<24} for {age}   "
             f"{a.get('detail', '')}"
+        )
+    return out
+
+
+def _fleet_lines(payload: dict) -> List[str]:
+    """The cluster panel (obs/fleet.py collector payloads): per-target
+    scrape health — a STALE target is the headline — merge exclusions
+    (version skew, named and counted, never averaged in), per-broker
+    sessions + server-side turn rates from each broker's own timeline
+    summary, and the cross-broker tenant-skew verdict. Non-fleet
+    payloads render nothing."""
+    fl = payload.get("fleet")
+    if not isinstance(fl, dict):
+        return []
+    targets = fl.get("targets") or []
+    stale = sum(1 for t in targets if t.get("state") == "stale")
+    head = (
+        f"FLEET ({len(targets)} target(s) @ {fl.get('interval_s', '?')}s "
+        f"sweeps, {fl.get('sweeps', 0)} sweep(s) done)"
+    )
+    if stale:
+        head += f"   ** {stale} STALE **"
+    out = [head]
+    for t in targets:
+        state = str(t.get("state", "?"))
+        mark = "**" if state == "stale" else "  "
+        kind = "worker" if t.get("worker") else "broker"
+        age = t.get("last_success_age_s")
+        age_s = f"{age:.1f}s ago" if isinstance(age, (int, float)) else "never"
+        line = (
+            f"  {mark}{t.get('address', '?'):<22} {kind:<6} {state:<8}"
+            f" ok {int(t.get('ok_total') or 0):>4}"
+            f"  err {int(t.get('err_total') or 0):>4}  last ok {age_s}"
+        )
+        fails = t.get("consecutive_failures") or 0
+        if fails:
+            line += f"  ({fails} consecutive: {t.get('error')})"
+        out.append(line)
+    for addr, why in sorted((fl.get("merge_excluded") or {}).items()):
+        out.append(f"  !! {addr} EXCLUDED from merge: {why}")
+    brokers = fl.get("broker_status") or {}
+    if brokers:
+        out.append(
+            "  per-broker                sessions  turns/s  universe-turns/s"
+        )
+        for addr in sorted(brokers):
+            p = brokers[addr]
+            summary = (p.get("timeline") or {}).get("summary") or {}
+            tr = (summary.get("gol_engine_turns_total") or {}).get("rate_per_s")
+            sr = (summary.get("gol_session_turns_total") or {}).get(
+                "rate_per_s")
+            active = _scalar(p.get("metrics") or {}, "gol_sessions_active")
+            out.append(
+                f"  {addr:<26} {int(active or 0):>8}  "
+                f"{(f'{tr:,.1f}' if tr is not None else '-'):>7}  "
+                f"{(f'{sr:,.1f}' if sr is not None else '-'):>7}"
+            )
+    skew = fl.get("tenant_skew") or {}
+    if skew.get("tenant") is not None:
+        out.append(
+            f"  tenant skew {skew.get('value', 0):.2f}x fair share: "
+            f"'{skew['tenant']}' hottest on {skew.get('address')}"
         )
     return out
 
@@ -640,6 +703,7 @@ def render_status(
     snap = payload.get("metrics") or {}
     sections = [
         _alert_lines(payload),
+        _fleet_lines(payload),
         _throughput_lines(snap, turns_rate),
         _timeline_lines(payload),
         _rpc_lines(snap),
@@ -668,10 +732,20 @@ def render_status(
 
 class Watcher:
     """Polls one broker + N workers, remembering the previous poll per
-    target so counter deltas become rates."""
+    target so counter deltas become rates.
+
+    Workers are AUTO-DISCOVERED from the broker's ``worker_health``
+    roster each frame (manual ``-worker`` flags are additive extras,
+    not a requirement), and all targets are polled in parallel
+    (``status.fetch_many``) so one wedged target costs one timeout.
+    Pointed at a fleet collector (obs/fleet.py, ``role="fleet"``), the
+    frame renders the FLEET panel plus one sub-panel per broker from
+    the collector's ``broker_status`` — one address, whole cluster."""
 
     def __init__(self, broker: str, workers: List[str], timeout: float):
-        self.targets = [(broker, False)] + [(w, True) for w in workers]
+        self.targets = [(norm_address(broker), False)] + [
+            (norm_address(w), True) for w in workers
+        ]
         self.timeout = timeout
         self._prev: Dict[str, Tuple[float, float]] = {}  # addr -> (t, turns)
         # addr -> last timeline seq received: echoed back so a -timeline
@@ -721,40 +795,81 @@ class Watcher:
             cache.values(), key=lambda r: -(r.get("self") or 0)
         )[:40]
 
+    def _spec(self, addr: str, is_worker: bool) -> dict:
+        return {
+            "address": addr, "worker": is_worker,
+            "timeline_since": self._tl_seq.get(addr, 0),
+            "journal_since": self._jr_seq.get(addr, 0),
+            "profile_since": self._pr_seq.get(addr, 0),
+        }
+
     def frame(self) -> Tuple[str, bool]:
         """(rendered frame, primary target ok)."""
         stamp = time.strftime("%Y-%m-%d %H:%M:%S")
         blocks = [f"gol watch — {stamp}   (read-only Status polls)"]
         primary_ok = False
-        for i, (addr, is_worker) in enumerate(self.targets):
+        ordered = list(self.targets)
+        results = fetch_many(
+            [self._spec(a, w) for a, w in ordered], timeout=self.timeout
+        )
+        # roster auto-discovery: workers each broker payload names get a
+        # second (also parallel) round — no -worker flags required
+        seen = {a for a, _ in ordered}
+        discovered: List[Tuple[str, bool]] = []
+        for addr, is_worker in list(ordered):
+            payload = (results.get(addr) or (None,))[0]
+            if payload is None or is_worker:
+                continue
+            for entry in payload.get("workers") or []:
+                if not isinstance(entry, dict):
+                    continue
+                waddr = entry.get("address")
+                if not isinstance(waddr, str) or ":" not in waddr:
+                    continue
+                waddr = norm_address(waddr)
+                if waddr not in seen:
+                    seen.add(waddr)
+                    discovered.append((waddr, True))
+        if discovered:
+            results.update(fetch_many(
+                [self._spec(a, w) for a, w in discovered],
+                timeout=self.timeout,
+            ))
+            ordered.extend(discovered)
+        for i, (addr, is_worker) in enumerate(ordered):
             kind = "worker" if is_worker else "broker"
-            try:
-                payload = fetch_status(
-                    addr, worker=is_worker, timeout=self.timeout,
-                    timeline_since=self._tl_seq.get(addr, 0),
-                    journal_since=self._jr_seq.get(addr, 0),
-                    profile_since=self._pr_seq.get(addr, 0),
-                )
-                seq = (payload.get("timeline") or {}).get("seq")
-                if isinstance(seq, int):
-                    self._tl_seq[addr] = seq
-                jseq = (payload.get("journal") or {}).get("seq")
-                if isinstance(jseq, int):
-                    self._jr_seq[addr] = jseq
-                self._merge_profile(addr, payload)
-            except StatusUnavailable as exc:
-                blocks.append(f"== {kind} {addr}: no status — {exc}")
+            payload, _fetched_at, error = results.get(addr) or (
+                None, 0.0, "no result")
+            if error is not None:
+                blocks.append(f"== {kind} {addr}: poll failed — {error}")
                 continue
-            except Exception as exc:
-                blocks.append(f"== {kind} {addr}: poll failed — {exc}")
-                continue
+            seq = (payload.get("timeline") or {}).get("seq")
+            if isinstance(seq, int):
+                self._tl_seq[addr] = seq
+            jseq = (payload.get("journal") or {}).get("seq")
+            if isinstance(jseq, int):
+                self._jr_seq[addr] = jseq
+            self._merge_profile(addr, payload)
             if i == 0:
                 primary_ok = True
+            is_fleet = payload.get("role") == "fleet"
             blocks.append(
                 render_status(
-                    f"{kind} {addr}", payload, self._turns_rate(addr, payload)
+                    f"{'fleet' if is_fleet else kind} {addr}", payload,
+                    self._turns_rate(addr, payload),
                 )
             )
+            if is_fleet:
+                # one sub-panel per broker the collector scraped this
+                # sweep — the whole cluster behind ONE address
+                brokers = (payload.get("fleet") or {}).get(
+                    "broker_status") or {}
+                for baddr in sorted(brokers):
+                    bp = brokers[baddr]
+                    blocks.append(render_status(
+                        f"broker {baddr} (via fleet)", bp,
+                        self._turns_rate(baddr, bp),
+                    ))
         return "\n\n".join(blocks), primary_ok
 
 
@@ -762,11 +877,15 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="live terminal dashboard over the read-only Status verb"
     )
-    parser.add_argument("address", help="broker host:port (or :port)")
+    parser.add_argument(
+        "address",
+        help="broker host:port (or :port) — or a fleet collector "
+             "(obs/fleet.py) address, which renders the whole cluster",
+    )
     parser.add_argument(
         "-worker", action="append", default=[], metavar="HOST:PORT",
-        help="also poll this worker's GameOfLifeOperations.Status "
-             "(repeatable)",
+        help="extra worker to poll beyond the broker's worker_health "
+             "roster, which is auto-discovered every frame (repeatable)",
     )
     parser.add_argument(
         "-interval", type=float, default=2.0, metavar="SECONDS",

@@ -2187,6 +2187,9 @@ class BrokerService:
             journal_since=jsince if isinstance(jsince, int) else 0,
             profile_since=psince if isinstance(psince, int) else 0,
         )
+        # the admission bound (-session-capacity): the denominator the
+        # fleet collector's capacity-headroom rule sums across brokers
+        payload["session_capacity"] = self._session_capacity
         health = getattr(self.backend, "worker_health", None)
         if callable(health):
             try:

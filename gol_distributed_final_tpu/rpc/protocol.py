@@ -38,7 +38,12 @@ class Methods:
     QUIT = "Operations.Quit"
     SUPER_QUIT = "Operations.SuperQuit"
     # extension: read-only metrics snapshot (obs/) — interrogate a running
-    # server without touching the engine or the board
+    # server without touching the engine or the board. Three roles answer
+    # this verb: a broker (role="broker"), a worker (via WORKER_STATUS,
+    # role="worker"), and the fleet collector (obs/fleet.py,
+    # role="fleet"), whose payload carries the exactly-merged cluster
+    # registry plus a "fleet" section of per-target scrape health — the
+    # same verb, so every Status consumer reaches all three unchanged
     STATUS = "Operations.Status"
     WORKER_UPDATE = "GameOfLifeOperations.Update"
     WORKER_QUIT = "GameOfLifeOperations.WorkerQuit"

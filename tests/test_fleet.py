@@ -1,0 +1,534 @@
+"""Fleet-collector tests (obs/fleet.py + friends): the parallel Status
+fan-out contract, EXACT cross-host registry merge over live targets with
+overlapping labelled counters/histograms, counter reset between sweeps
+(target restart), version-skew exclusion (loud, never wrong), staleness
+marking + the ``target-down`` page, the fleet doctor finding that names
+a dead target with its scrape evidence, watch's zero-flag FLEET panel —
+and the live acceptance drill: two subprocess brokers (one resident-wire
+over two workers), SIGKILL one broker, and the whole fleet surface must
+tell the truth about it within the staleness bound.
+"""
+
+import socket
+import time
+
+import pytest
+
+from gol_distributed_final_tpu.obs import doctor as obs_doctor
+from gol_distributed_final_tpu.obs import fleet as obs_fleet
+from gol_distributed_final_tpu.obs import metrics as obs_metrics
+from gol_distributed_final_tpu.obs.status import (
+    fetch_many,
+    fetch_status,
+    scalar_value,
+    series_map,
+)
+from gol_distributed_final_tpu.rpc.protocol import Methods, Response
+from gol_distributed_final_tpu.rpc.server import RpcServer
+
+from test_rpc import _spawn, _wait_listening
+
+
+@pytest.fixture
+def live_metrics():
+    """Enable the process-global registry for one test, zeroed before and
+    disabled+zeroed after (the test_obs.py posture)."""
+    reg = obs_metrics.registry()
+    reg.reset()
+    obs_metrics.enable()
+    yield reg
+    obs_metrics.enable(False)
+    reg.reset()
+
+
+class _StubTarget:
+    """A live loopback Status server with a fully scripted payload — the
+    per-process registry under test's total control (distinct synthetic
+    'hosts', unlike in-process brokers that share one global registry)."""
+
+    def __init__(self, payload):
+        self.payload = payload
+        self.requests = []
+        self.server = RpcServer(port=0)
+
+        def _status(req):
+            self.requests.append(req)
+            return Response(status=self.payload)
+
+        self.server.register(Methods.STATUS, _status)
+        self.server.register(Methods.WORKER_STATUS, _status)
+        self.server.serve_background()
+        self.address = f"127.0.0.1:{self.server.port}"
+
+    def stop(self):
+        """Stop AND verify the port refuses. RpcServer.stop() closes the
+        listener fd, but a thread already blocked in accept() holds the
+        open file description until its syscall returns — so the port can
+        keep accepting. One kick connection releases it; poll until the
+        OS actually refuses (these tests need dead to MEAN dead)."""
+        self.server.stop()
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            try:
+                kick = socket.create_connection(
+                    ("127.0.0.1", self.server.port), timeout=1.0)
+                kick.close()
+                time.sleep(0.01)
+            except OSError:
+                return
+        raise RuntimeError("stub port still accepting after stop()")
+
+
+def _snap(counters=(), hist=None, edges=(0.1, 1.0)):
+    """A synthetic per-process registry snapshot: one labelled counter
+    family and (optionally) one fixed-edge histogram family."""
+    fams = []
+    if counters:
+        fams.append({
+            "name": "t_requests_total", "type": "counter", "help": "t",
+            "labelnames": ["code"],
+            "series": [
+                {"labels": list(labels), "value": value}
+                for labels, value in counters
+            ],
+        })
+    if hist is not None:
+        fams.append({
+            "name": "t_latency_seconds", "type": "histogram", "help": "t",
+            "labelnames": [], "le": list(edges),
+            "series": [{
+                "labels": [], "buckets": list(hist),
+                "sum": float(sum(hist)), "count": float(sum(hist)),
+            }],
+        })
+    return {"schema": "gol-metrics/1", "families": fams}
+
+
+def _dead_address() -> str:
+    """A loopback port with NO listener: bound once to claim a fresh
+    ephemeral port, then fully closed before anyone connects."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return f"127.0.0.1:{port}"
+
+
+def _payload(snap, pid=4242, **extra):
+    p = {"schema": "gol-status/1", "pid": pid, "time_unix": time.time(),
+         "role": "worker", "metrics_enabled": True, "metrics": snap}
+    p.update(extra)
+    return p
+
+
+# -- fetch_many contract ------------------------------------------------------
+
+
+def test_fetch_many_exactly_one_of_payload_or_error(live_metrics):
+    """Every target gets a (payload, fetched_at, error) triple with
+    exactly one of payload/error set — a dead target is DATA."""
+    stub = _StubTarget(_payload(_snap(counters=((("a",), 1.0),))))
+    dead_addr = _dead_address()
+    try:
+        results = fetch_many(
+            [{"address": f"tcp://{stub.address}", "worker": True},
+             {"address": dead_addr, "worker": True}],
+            timeout=5.0,
+        )
+        assert set(results) == {stub.address, dead_addr}
+        payload, fetched_at, error = results[stub.address]
+        assert error is None and isinstance(payload, dict)
+        assert isinstance(fetched_at, float)
+        payload, fetched_at, error = results[dead_addr]
+        assert payload is None and isinstance(error, str) and error
+        assert isinstance(fetched_at, float)
+    finally:
+        stub.stop()
+
+
+# -- exact merge over live targets -------------------------------------------
+
+
+def test_merge_is_exact_over_overlapping_labelled_series(live_metrics):
+    """Three live 'hosts' with overlapping labelled counters and a shared
+    fixed-edge histogram: every merged counter equals the ARITHMETIC SUM
+    of the per-process values, every histogram bucket the per-bucket sum
+    — bit-exact, the PR 1 merge contract at fleet scale."""
+    stubs = [
+        _StubTarget(_payload(_snap(
+            counters=((("ok",), 3.0), (("err",), 1.0)),
+            hist=[1, 2, 3],
+        ), pid=100 + i))
+        for i in range(2)
+    ]
+    stubs.append(_StubTarget(_payload(_snap(
+        counters=((("ok",), 10.0), (("timeout",), 7.0)),
+        hist=[5, 0, 1],
+    ), pid=102)))
+    collector = obs_fleet.FleetCollector(
+        [], extra_workers=[s.address for s in stubs], interval=0.2,
+        timeout=5.0,
+    )
+    try:
+        fleet = collector.sweep()
+        assert fleet["merge_excluded"] == {}
+        merged = collector.status_payload()["metrics"]
+        req = series_map(merged, "t_requests_total")
+        assert req[("ok",)]["value"] == 3.0 + 3.0 + 10.0
+        assert req[("err",)]["value"] == 1.0 + 1.0
+        assert req[("timeout",)]["value"] == 7.0
+        lat = series_map(merged, "t_latency_seconds")[()]
+        assert lat["buckets"] == [1 + 1 + 5, 2 + 2 + 0, 3 + 3 + 1]
+        assert lat["count"] == 6.0 + 6.0 + 6.0
+    finally:
+        for s in stubs:
+            s.stop()
+
+
+def test_counter_reset_between_sweeps_stays_exact(live_metrics):
+    """Only the CURRENT sweep's snapshots are merged: a target restart
+    (counters reset, new pid) between polls yields merged totals exactly
+    equal to the restarted process's own snapshot — never a stale sum —
+    and the restart resets the echoed incremental cursors."""
+    stub = _StubTarget(_payload(
+        _snap(counters=((("ok",), 100.0),)), pid=1111,
+        timeline={"seq": 7, "samples": []},
+    ))
+    collector = obs_fleet.FleetCollector(
+        [], extra_workers=[stub.address], interval=0.2, timeout=5.0)
+    try:
+        collector.sweep()
+        assert stub.requests[-1].timeline_since == 0
+        collector.sweep()
+        # the cursor echoed back is the last seq the collector received
+        assert stub.requests[-1].timeline_since == 7
+        # restart: new pid, counters reset, seq numbering begins again
+        stub.payload = _payload(
+            _snap(counters=((("ok",), 5.0),)), pid=2222,
+            timeline={"seq": 2, "samples": []},
+        )
+        collector.sweep()
+        merged = collector.status_payload()["metrics"]
+        assert series_map(merged, "t_requests_total")[("ok",)]["value"] == 5.0
+        collector.sweep()
+        # the pid change dropped the pre-restart cursor (7): the echo now
+        # follows the restarted numbering, not the dead process's
+        assert stub.requests[-1].timeline_since == 2
+        (row,) = collector.status_payload()["fleet"]["targets"]
+        assert row["cursors"]["timeline_since"] == 2
+    finally:
+        stub.stop()
+
+
+def test_version_skew_is_excluded_loudly_never_wrongly(live_metrics):
+    """A target missing the metrics snapshot (old server) and a target
+    whose histogram edges mismatch (skewed build) are both EXCLUDED from
+    the merge by name with a reason and counted in
+    gol_fleet_merge_failures_total — while the merged totals stay exactly
+    the sum of the included snapshots."""
+    good = _StubTarget(_payload(
+        _snap(counters=((("ok",), 3.0),), hist=[1, 2, 3], edges=(0.1, 1.0)),
+        pid=1))
+    old = _StubTarget({"schema": "gol-status/1", "pid": 2,
+                       "role": "worker"})  # no metrics at all
+    skewed = _StubTarget(_payload(
+        _snap(counters=((("ok",), 50.0),), hist=[9, 9, 9], edges=(0.5, 5.0)),
+        pid=3))
+    collector = obs_fleet.FleetCollector(
+        [], extra_workers=[good.address, old.address, skewed.address],
+        interval=0.2, timeout=5.0)
+    try:
+        fleet = collector.sweep()
+        excluded = fleet["merge_excluded"]
+        assert old.address in excluded and "skew" in excluded[old.address]
+        # the merge folds in sorted-address order: ONE of the two
+        # edge-mismatched snapshots lands, the other is refused — which
+        # one depends on the ephemeral ports, but exactly one is out
+        edge_excluded = set(excluded) - {old.address}
+        assert len(edge_excluded) == 1
+        (loser,) = edge_excluded
+        assert "mismatch" in excluded[loser]
+        winner = {good.address: 3.0, skewed.address: 50.0}[
+            ({good.address, skewed.address} - {loser}).pop()]
+        merged = collector.status_payload()["metrics"]
+        assert series_map(merged, "t_requests_total")[("ok",)]["value"] == winner
+        failures = scalar_value(merged, "gol_fleet_merge_failures_total")
+        assert failures == 2.0
+        # the skew degrades LOUDLY in every consumer: watch renders the
+        # exclusions, it never crashes on the thin payload
+        from gol_distributed_final_tpu.obs.watch import render_status
+
+        text = render_status("fleet", collector.status_payload())
+        assert "EXCLUDED" in text
+    finally:
+        good.stop()
+        old.stop()
+        skewed.stop()
+
+
+# -- staleness + the target-down page ----------------------------------------
+
+
+def test_dead_target_goes_stale_and_target_down_fires(live_metrics):
+    """A target that stops answering is marked failing, then STALE once
+    its last-success age passes STALE_INTERVALS sweeps; the
+    gol_fleet_targets_down gauge counts it and the target-down page
+    fires over the merged timeline."""
+    stub = _StubTarget(_payload(_snap(counters=((("ok",), 1.0),))))
+    collector = obs_fleet.FleetCollector(
+        [], extra_workers=[stub.address], interval=1.0, timeout=2.0)
+    (row,) = collector.sweep()["targets"]
+    assert row["state"] == "ok"
+    stub.stop()
+    (row,) = collector.sweep(wall=time.time())["targets"]
+    assert row["state"] == "failing"
+    assert row["consecutive_failures"] == 1 and row["error"]
+    # past the bound (3 x 1.0 s interval): STALE, gauge up, page firing
+    later = time.time() + 3.0 * collector.interval + 2.0
+    (row,) = collector.sweep(now=later, wall=later)["targets"]
+    assert row["state"] == "stale"
+    payload = collector.status_payload()
+    assert scalar_value(payload["metrics"], "gol_fleet_targets_down") == 1.0
+    alerts = {a["rule"]: a for a in payload["alerts"]}
+    assert alerts["target-down"]["state"] == "firing"
+    assert alerts["target-down"]["severity"] == "page"
+
+
+def test_fleet_doctor_names_dead_target_with_scrape_evidence(live_metrics):
+    """The doctor's TOP finding on a fleet payload with a stale broker
+    names the dead address and carries the scrape health as evidence —
+    a dead broker is a first-class finding, not a timeout traceback."""
+    stub = _StubTarget(_payload(_snap(counters=((("ok",), 1.0),)),
+                                role="broker"))
+    # a short REAL cadence: status_payload() judges staleness against the
+    # real clock, so the bound (3 x 0.05 s) must pass in real time
+    collector = obs_fleet.FleetCollector(
+        [stub.address], interval=0.05, timeout=2.0)
+    collector.sweep()
+    stub.stop()
+    deadline = time.time() + 30.0
+    while time.time() < deadline:
+        collector.sweep()
+        payload = collector.status_payload()
+        if payload["fleet"]["targets"][0]["state"] == "stale":
+            break
+        time.sleep(0.06)
+    else:
+        pytest.fail("target never went stale")
+    findings = obs_doctor.diagnose({"fleet 127.0.0.1:9": payload})
+    top = findings[0]
+    assert top["severity"] == "page"
+    assert stub.address in top["title"] and "DOWN" in top["title"]
+    evidence = "\n".join(top.get("evidence", []))
+    assert "consecutive failure" in evidence
+    assert "last successful scrape" in evidence
+    text = obs_doctor.render(findings, {"fleet 127.0.0.1:9": payload})
+    assert stub.address in text
+
+
+# -- watch through the collector ---------------------------------------------
+
+
+def test_watch_renders_fleet_and_per_broker_panels_zero_flags(live_metrics):
+    """Watch pointed at ONE address — the collector's — renders the
+    FLEET panel plus a per-broker sub-panel, and the broker's workers
+    are scraped by roster auto-discovery: zero manual -worker flags."""
+    worker_stub = _StubTarget(_payload(
+        _snap(counters=((("ok",), 2.0),)), pid=11))
+    broker_stub = _StubTarget(_payload(
+        _snap(counters=((("ok",), 1.0),)), pid=12, role="broker",
+        workers=[{"address": worker_stub.address, "state": "READY",
+                  "retry_in_s": None}],
+    ))
+    collector = obs_fleet.FleetCollector(
+        [broker_stub.address], interval=0.2, timeout=5.0)
+    server = None
+    try:
+        collector.sweep()  # scrapes the broker, learns its roster
+        fleet = collector.sweep()  # scrapes the discovered worker too
+        rows = {r["address"]: r for r in fleet["targets"]}
+        assert rows[worker_stub.address]["worker"] is True
+        assert rows[worker_stub.address]["via"] == broker_stub.address
+        assert rows[worker_stub.address]["state"] == "ok"
+        # merged = broker + the AUTO-DISCOVERED worker, exactly
+        merged = collector.status_payload()["metrics"]
+        assert series_map(merged, "t_requests_total")[("ok",)]["value"] == 3.0
+        server = obs_fleet.serve(collector, port=0)
+        from gol_distributed_final_tpu.obs.watch import Watcher
+
+        frame, ok = Watcher(
+            f"127.0.0.1:{server.port}", [], timeout=5.0).frame()
+        assert ok
+        assert "FLEET" in frame
+        assert broker_stub.address in frame
+        assert "via fleet" in frame
+    finally:
+        if server is not None:
+            server.stop()
+        broker_stub.stop()
+        worker_stub.stop()
+
+
+# -- the live acceptance drill (subprocess cluster; slow-marked) --------------
+
+
+# the exactness family for the live drill: the resident run leaves it
+# NONZERO on broker and workers alike (in-header frame crcs + halo
+# attestations) and QUIESCENT afterwards — unlike the rpc request/byte
+# counters, which every Status scrape itself moves
+_DRILL_FAMILY = "gol_integrity_checks_total"
+
+
+def _family_values(addr: str, worker: bool) -> dict:
+    """{labels: value} of the drill family from one independent fetch."""
+    p = fetch_status(addr, worker=worker, timeout=10.0)
+    return {
+        labels: s.get("value") or 0.0
+        for labels, s in series_map(p.get("metrics") or {}, _DRILL_FAMILY).items()
+    }
+
+
+def _summed(maps) -> dict:
+    out = {}
+    for m in maps:
+        for labels, v in m.items():
+            out[labels] = out.get(labels, 0.0) + v
+    return out
+
+
+@pytest.mark.slow
+def test_live_fleet_drill_sigkill_broker(live_metrics):
+    """The acceptance drill, live: a collector over TWO subprocess
+    brokers (one resident-wire over two subprocess workers), worker
+    auto-discovery, exact 4-way merge — then SIGKILL one broker and
+    within the staleness bound the fleet Status marks it stale, the
+    target-down page fires, the fleet doctor's TOP finding names the
+    dead broker with scrape evidence, and the merged counters stay
+    exactly equal to the sum of the SURVIVING targets' snapshots."""
+    import numpy as np
+
+    from gol_distributed_final_tpu.rpc.client import RpcClient
+    from gol_distributed_final_tpu.rpc.protocol import Request
+
+    workers = [
+        _spawn("gol_distributed_final_tpu.rpc.worker", "-port", "0",
+               "-metrics")
+        for _ in range(2)
+    ]
+    broker_a = broker_b = fleet_server = None
+    try:
+        wports = [_wait_listening(w) for w in workers]
+        waddrs = [f"127.0.0.1:{p}" for p in wports]
+        broker_a = _spawn(
+            "gol_distributed_final_tpu.rpc.broker",
+            "-port", "0", "-backend", "workers", "-metrics",
+            "-workers", ",".join(waddrs),
+            "-wire", "resident", "-halo-depth", "8",
+        )
+        broker_b = _spawn(
+            "gol_distributed_final_tpu.rpc.broker", "-port", "0", "-metrics")
+        addr_a = f"127.0.0.1:{_wait_listening(broker_a)}"
+        addr_b = f"127.0.0.1:{_wait_listening(broker_b)}"
+        # real work through the resident wire, so engine-turn counters
+        # are nonzero and STATIC afterwards (exactness needs quiescence)
+        rng = np.random.default_rng(7)
+        board = np.where(
+            rng.random((64, 64)) < 0.3, 255, 0).astype(np.uint8)
+        client = RpcClient(addr_a)
+        try:
+            client.call(
+                Methods.BROKER_RUN,
+                Request(world=board, turns=8, image_width=64,
+                        image_height=64, threads=2),
+                timeout=180.0,
+            )
+        finally:
+            client.close()
+
+        collector = obs_fleet.FleetCollector(
+            [addr_a, addr_b], interval=0.2, timeout=10.0)
+        collector.sweep()  # brokers + roster discovery
+        fleet = collector.sweep()  # + the discovered workers
+        rows = {r["address"]: r for r in fleet["targets"]}
+        assert set(rows) == {addr_a, addr_b, *waddrs}
+        for waddr in waddrs:
+            assert rows[waddr]["worker"] is True
+            assert rows[waddr]["via"] == addr_a  # auto-discovered
+            assert rows[waddr]["state"] == "ok"
+        assert fleet["merge_excluded"] == {}
+        # exact 4-way merge: every labelled series of the drill family
+        # equals the arithmetic sum of the four per-process snapshots,
+        # each fetched independently of the collector
+        want = _summed([
+            _family_values(addr_a, False), _family_values(addr_b, False),
+            *(_family_values(w, True) for w in waddrs),
+        ])
+        assert sum(want.values()) > 0
+        merged = collector.status_payload()["metrics"]
+        got = {
+            labels: s.get("value") or 0.0
+            for labels, s in series_map(merged, _DRILL_FAMILY).items()
+        }
+        assert got == want
+
+        broker_b.kill()  # SIGKILL: no shutdown path, no goodbyes
+        broker_b.wait()
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            collector.sweep()
+            payload = collector.status_payload()
+            row_b = {
+                r["address"]: r for r in payload["fleet"]["targets"]
+            }[addr_b]
+            if row_b["state"] == "stale":
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail("killed broker never went stale")
+        assert row_b["consecutive_failures"] >= 1
+        assert row_b["error"]
+        # the dead broker left the merge within one sweep: merged totals
+        # are exactly the sum of the SURVIVING targets' own snapshots
+        assert addr_b not in payload["fleet"]["broker_status"]
+        survivors = _summed([
+            _family_values(addr_a, False),
+            *(_family_values(w, True) for w in waddrs),
+        ])
+        got = {
+            labels: s.get("value") or 0.0
+            for labels, s in series_map(
+                payload["metrics"], _DRILL_FAMILY).items()
+        }
+        assert got == survivors
+        assert scalar_value(
+            payload["metrics"], "gol_fleet_targets_down") == 1.0
+        alerts = {a["rule"]: a for a in payload["alerts"]}
+        assert alerts["target-down"]["state"] == "firing"
+
+        # every consumer at ONE address: the fleet doctor's top finding
+        # names the dead broker with its scrape evidence; watch renders
+        # FLEET + the surviving broker's sub-panel, zero -worker flags
+        fleet_server = obs_fleet.serve(collector, port=0)
+        fleet_addr = f"127.0.0.1:{fleet_server.port}"
+        statuses = obs_doctor.collect(fleet_addr, [], timeout=10.0)
+        findings = obs_doctor.diagnose(statuses)
+        top = findings[0]
+        assert top["severity"] == "page"
+        assert addr_b in top["title"] and "DOWN" in top["title"]
+        assert any("consecutive failure" in e
+                   for e in top.get("evidence", []))
+        from gol_distributed_final_tpu.obs.watch import Watcher
+
+        frame, ok = Watcher(fleet_addr, [], timeout=10.0).frame()
+        assert ok
+        assert "FLEET" in frame
+        assert addr_a in frame and addr_b in frame
+        assert "via fleet" in frame
+    finally:
+        if fleet_server is not None:
+            fleet_server.stop()
+        for p in (*workers, broker_a, broker_b):
+            if p is not None and p.poll() is None:
+                p.kill()
+            if p is not None:
+                p.wait()

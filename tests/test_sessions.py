@@ -507,7 +507,10 @@ def test_session_retrieve_by_tag_mid_flight():
         with service._sessions_lock:
             service._sessions = SessionScheduler(capacity=4, max_chunk=1)
         boards = _mixed_batch(b=1, h=32, w=32, seed=13)
-        turns = 60
+        # wide enough that the watcher reliably lands mid-flight even on
+        # a loaded host (the full suite runs alongside): ~240 driver
+        # boundaries vs a single already-connected Retrieve round-trip
+        turns = 240
         done = threading.Event()
         result: dict = {}
 
@@ -523,9 +526,11 @@ def test_session_retrieve_by_tag_mid_flight():
                 rb.client.close()
                 done.set()
 
+        # connect the watcher BEFORE the run starts: its first Retrieve
+        # races only the session admission, not TCP connect setup
+        rb2 = RemoteBroker(addr)
         t = threading.Thread(target=run)
         t.start()
-        rb2 = RemoteBroker(addr)
         snap = None
         try:
             deadline = time.monotonic() + 30
