@@ -603,6 +603,107 @@ def _bench_wire_modes(extra: dict) -> int:
     return 0
 
 
+def _bench_tile_grid(extra: dict) -> int:
+    """2-D checkerboard tiles vs 1-D strips (config 13): the SAME
+    4-worker loopback resident cluster, K=8, on a square 256² board —
+    ``-grid 2x2`` (each worker owns a 128² tile, per-worker wire cost
+    O(K·(tile_h+tile_w)) in packed bits) against ``-grid 1x4`` (full
+    256-wide strips, O(K·W) raw rows). Each case embeds
+    ``halo_bytes_per_turn`` measured from ``gol_halo_bytes_total`` so
+    ``obs/regress.py`` gates the halo trajectory across rounds; the
+    pair itself is a HARD deterministic gate here — the two boards must
+    be bit-identical and the 2x2 halo bytes must come in at ≤ 0.6x of
+    the strip plane's (byte accounting is exact, unlike loopback
+    timing; the square board is the strip plane's BEST case, so the
+    margin is all bit-packing and corner geometry)."""
+    import numpy as np
+
+    from gol_distributed_final_tpu.obs import metrics as obs_metrics
+    from gol_distributed_final_tpu.rpc import worker as rpc_worker
+    from gol_distributed_final_tpu.rpc.broker import WorkersBackend
+    from gol_distributed_final_tpu.rpc.protocol import Request
+
+    def halo_bytes() -> float:
+        for fam in obs_metrics.registry().snapshot()["families"]:
+            if fam["name"] == "gol_halo_bytes_total":
+                return sum(s["value"] for s in fam["series"])
+        return 0.0
+
+    size = 256
+    servers = [rpc_worker.serve(port=0) for _ in range(4)]
+    addrs = [f"127.0.0.1:{s.port}" for s, _ in servers]
+    rng = np.random.default_rng(3)
+    board = np.where(rng.random((size, size)) < 0.3, 255, 0).astype(np.uint8)
+    want100 = None  # cross-grid parity reference (100 turns)
+    try:
+        for grid, key, n_lo, n_hi in (
+            ("2x2", "c13_tile_2x2_k8", 100, 1100),
+            # the SAME roster forced into the legacy strip plane (an
+            # explicit one-column grid routes the strip loop, byte-
+            # identical to a plain resident run) — the baseline the
+            # tile gate below is measured against
+            ("1x4", "c13_tile_1x4_k8", 100, 1100),
+        ):
+            backend = WorkersBackend(
+                addrs, wire="resident", halo_depth=8, grid=grid
+            )
+            try:
+                def evolve(n, backend=backend):
+                    return backend.run(
+                        Request(
+                            world=board, turns=n, threads=4,
+                            image_width=size, image_height=size,
+                        )
+                    )
+
+                got = np.asarray(evolve(100).world)
+                if want100 is None:
+                    want100 = got
+                elif not np.array_equal(got, want100):
+                    print(
+                        f"TILE PARITY FAILURE: grid={grid} diverges from "
+                        f"2x2 at 100 turns", file=sys.stderr,
+                    )
+                    return 1
+                n_bytes = 400
+                b0 = halo_bytes()
+                evolve(n_bytes)
+                per_turn_halo = (halo_bytes() - b0) / n_bytes
+                pt, det = gated(evolve, n_lo, n_hi, key)
+                extra[key] = dict(
+                    det,
+                    cell_updates_per_s=round(size * size / pt),
+                    wire="resident",
+                    halo_depth=8,
+                    grid=grid,
+                    halo_bytes_per_turn=round(per_turn_halo, 1),
+                )
+            finally:
+                backend.close()
+        print("parity tile grids ok (100 turns, 2x2 vs 1x4)", file=sys.stderr)
+        tile = extra["c13_tile_2x2_k8"]["halo_bytes_per_turn"]
+        strip = extra["c13_tile_1x4_k8"]["halo_bytes_per_turn"]
+        if not tile or not strip or tile > 0.6 * strip:
+            print(
+                f"TILE HALO GATE FAILURE: 2x2 moves {tile:.0f} halo B/turn "
+                f"vs 1x4 strips {strip:.0f} — more than the 0.6x contract",
+                file=sys.stderr,
+            )
+            return 1
+        extra["c13_tile_2x2_k8"]["halo_ratio_vs_strips"] = round(
+            tile / strip, 3
+        )
+        print(
+            f"tile halo gate ok: 2x2 {tile:.0f} halo B/turn vs 1x4 strips "
+            f"{strip:.0f} ({tile / strip:.2f}x, contract <= 0.6x)",
+            file=sys.stderr,
+        )
+    finally:
+        for server, _service in servers:
+            server.stop()
+    return 0
+
+
 def _bench_sparse_wire(extra: dict) -> int:
     """Dirty-tile delta syncs (config 11): a <1%-active 16384² R-pentomino
     on a loopback 4-worker RESIDENT cluster, measured at the run-end
@@ -1415,6 +1516,11 @@ def _bench_body() -> int:
 
     # ---- config 7: the RPC data plane — wire modes, loopback 4 workers ----
     rc = _bench_wire_modes(extra)
+    if rc:
+        return rc
+
+    # ---- config 13: 2-D tile grid vs strips — the halo-byte gate ---------
+    rc = _bench_tile_grid(extra)
     if rc:
         return rc
 

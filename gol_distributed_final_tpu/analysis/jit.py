@@ -50,6 +50,11 @@ ENTRY_POINTS: Dict[str, int] = {
     "fused_strip_steps": 1,
     "step_n_counted": 1,
     "step_n_counts": 1,
+    # the 2-D tile plane's K-batch entry (rpc/worker.py): numpy today,
+    # but the K argument is the same static batch-depth key the fused
+    # family compiles on — kept under the rule so a jitted tile kernel
+    # cannot regress the cache contract silently
+    "tile_step_batch": 2,
 }
 #: keyword spellings of the same argument (``k`` is the fused family's
 #: static turns-per-launch — same unbounded-cache hazard as ``n``)

@@ -494,6 +494,31 @@ EARLY_EXIT_TOTAL = _R.counter(
     labelnames=("kind",),
 )
 
+# -- 2-D tile data plane (rpc/broker.py -grid, rpc/worker.py tile batches) ---
+
+# terse help by design: every registered family's help rides EVERY
+# Status reply, which tests/test_tenants.py budgets at 64 KiB — the full
+# semantics live in README "## 2-D tiles" (lint-tile-names enforces it)
+HALO_BYTES_TOTAL = _R.counter(
+    "gol_halo_bytes_total",
+    "Resident-wire halo bytes moved, both directions, by axis "
+    "(row/col edge bands, corner KxK blocks).",
+    labelnames=("axis",),
+)
+TILE_EDGE_CELLS = _R.gauge(
+    "gol_tile_edge_cells",
+    "Cells in one K-batch halo exchange for the largest active tile "
+    "(2K(th+tw) + 4K^2; a 1-column grid counts its 2KW strip rows).",
+)
+TILE_GRID_ROWS = _R.gauge(
+    "gol_tile_grid_rows",
+    "Row bands of the active resident tile layout (N for strips).",
+)
+TILE_GRID_COLS = _R.gauge(
+    "gol_tile_grid_cols",
+    "Column bands of the active resident tile layout (1 for strips).",
+)
+
 # -- fused K-turns-per-launch stepping (ops/fused.py, rpc/worker.py) ---------
 
 FUSED_LAUNCHES_TOTAL = _R.counter(

@@ -69,6 +69,10 @@ _REQUIRED_SECTIONS = (
     "Perf regression gate",
     "Fault tolerance",
     "Wire modes",
+    # the 2-D checkerboard tile plane (-grid, rpc/broker._tile_turn_loop):
+    # grid knobs, the corner-halo cost table, the fault/attestation
+    # contract, and the halo-depth/sync-interval/sparse-sync interactions
+    "## 2-D tiles",
     "Integrity",
     "Sessions",
     "SLOs & alerting",
@@ -199,6 +203,29 @@ def undocumented_wire_metrics(readme_path=None) -> List[str]:
     mentioned elsewhere in the file does not count as documented here)."""
     section = _readme_section(readme_path, "## Wire modes")
     return sorted(n for n in _WIRE_METRIC_NAMES if n not in section)
+
+
+# the 2-D tile data plane's operator names (rpc/broker.py -grid + the
+# tile-resident wire): the per-axis halo counter, the layout gauges, and
+# the -grid knob itself must be documented in the README's "2-D tiles"
+# section specifically — the contract the tile bench pair embeds and the
+# regress halo-byte gate enforces
+_TILE_DOC_NAMES = (
+    "gol_halo_bytes_total",
+    "gol_tile_edge_cells",
+    "gol_tile_grid_rows",
+    "gol_tile_grid_cols",
+    "-grid",
+)
+
+
+def undocumented_tile_names(readme_path=None) -> List[str]:
+    """Tile data-plane names (metrics + the -grid knob) missing from the
+    README's "2-D tiles" section specifically (the wire/device-table
+    posture: a name mentioned elsewhere in the file does not count as
+    documented here)."""
+    section = _readme_section(readme_path, "## 2-D tiles")
+    return sorted(n for n in _TILE_DOC_NAMES if n not in section)
 
 
 # the serving-SLO metric families (obs/timeline.py sampler + obs/slo.py
@@ -502,6 +529,14 @@ CHECKS = (
         "section:",
         "wire-metric lint ok: every wire metric is in the Wire modes "
         "section",
+    ),
+    (
+        "lint-tile-names",
+        undocumented_tile_names,
+        "tile data-plane names (metrics / the -grid knob) missing from "
+        "README.md's 2-D tiles section:",
+        "tile-name lint ok: every tile data-plane name is in the "
+        "2-D tiles section",
     ),
     (
         "lint-integrity-metrics",

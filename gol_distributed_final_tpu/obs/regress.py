@@ -196,7 +196,8 @@ def compare_case(
         out = _apply_journal_gate(old, new, out, threshold)
         out = _apply_profile_gate(old, new, out, threshold)
         out = _apply_fleet_gate(old, new, out, threshold)
-        return _apply_wire_bytes_gate(old, new, out, threshold)
+        out = _apply_wire_bytes_gate(old, new, out, threshold)
+        return _apply_halo_bytes_gate(old, new, out, threshold)
     delta = new_us - old_us
     rel = delta / old_us
     noises = [
@@ -222,7 +223,8 @@ def compare_case(
     out = _apply_journal_gate(old, new, out, threshold)
     out = _apply_profile_gate(old, new, out, threshold)
     out = _apply_fleet_gate(old, new, out, threshold)
-    return _apply_wire_bytes_gate(old, new, out, threshold)
+    out = _apply_wire_bytes_gate(old, new, out, threshold)
+    return _apply_halo_bytes_gate(old, new, out, threshold)
 
 
 def _apply_roofline_gate(
@@ -452,6 +454,26 @@ def _apply_wire_bytes_gate(
         if bytes_rel > threshold:
             out["verdict"] = "REGRESSED"
             out["why"] = "wire bytes/turn grew past threshold"
+    return out
+
+
+def _apply_halo_bytes_gate(
+    old: dict, new: dict, out: dict, threshold: float
+) -> dict:
+    """The wire-bytes gate's resident-halo twin: tile/strip bench cases
+    embed ``halo_bytes_per_turn`` (gol_halo_bytes_total summed over
+    axes), and halo accounting is exactly as deterministic — a change
+    that quietly grows the halo cone (wider bands, unpacked corners, a
+    worse layout) gates here even when wall-clock looks fine."""
+    old_b, new_b = old.get("halo_bytes_per_turn"), new.get("halo_bytes_per_turn")
+    if old_b and new_b:
+        halo_rel = (new_b - old_b) / old_b
+        out["old_halo_bytes"] = old_b
+        out["new_halo_bytes"] = new_b
+        out["halo_bytes_delta_pct"] = 100.0 * halo_rel
+        if halo_rel > threshold:
+            out["verdict"] = "REGRESSED"
+            out["why"] = "halo bytes/turn grew past threshold"
     return out
 
 

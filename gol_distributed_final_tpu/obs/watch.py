@@ -258,7 +258,9 @@ def _wire_lines(snap: dict) -> List[str]:
     """The data-plane comms column: frame bytes this process's RPC
     clients moved per verb and direction (``gol_wire_bytes_total`` — the
     broker's scatter/StripStep traffic when polling a broker), the
-    turns-per-batch histogram (``gol_turn_batch_size``: K in resident
+    resident halo traffic split by axis (``gol_halo_bytes_total``:
+    row/col/corner — the -grid tile plane's O(K·edge) claim, measured),
+    the turns-per-batch histogram (``gol_turn_batch_size``: K in resident
     wire mode, 1 in full/haloed), and the resident full-resync count."""
     by_verb: Dict[str, Dict[str, float]] = {}
     for labels, series in _series_map(snap, "gol_wire_bytes_total").items():
@@ -277,6 +279,19 @@ def _wire_lines(snap: dict) -> List[str]:
             f"  {verb:<24} {_human_bytes(d.get('sent')):>9}  "
             f"{_human_bytes(d.get('received')):>9}"
         )
+    halo = _series_map(snap, "gol_halo_bytes_total")
+    if halo:
+        # resident halo traffic split by axis: on a -grid tile run the
+        # row/col/corner shares show the O(K*edge) scaling directly; the
+        # strip plane is all row-axis
+        parts = " ".join(
+            f"{(labels[0] if labels else '?')} "
+            f"{_human_bytes(series.get('value'))}"
+            for labels, series in sorted(halo.items())
+            if series.get("value")
+        )
+        if parts:
+            out.append(f"  halo bytes by axis: {parts}")
     tail = []
     if batch:
         count, mean = _hist_stats(batch)

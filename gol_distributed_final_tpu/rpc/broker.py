@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import math
 import os
 import pathlib
 import random
@@ -240,6 +241,80 @@ class _ResidentPlan:
         self.digests = digests or [None] * len(active)
 
 
+def parse_grid(spec):
+    """The -grid knob: ``None`` (legacy strip plane), ``"auto"`` (squarest
+    roster factorization weighted by board aspect — _auto_grid), or
+    ``"CxR"`` read width-by-height like the board flags: C tile COLUMNS by
+    R tile ROWS, so ``1x4`` is exactly today's four row strips and ``2x4``
+    puts eight workers on a four-row board. Returns ``None``, ``"auto"``
+    or ``(rows, cols)``; raises ValueError on anything else."""
+    if spec is None:
+        return None
+    s = str(spec).strip().lower()
+    if not s:
+        return None
+    if s == "auto":
+        return "auto"
+    parts = s.split("x")
+    if len(parts) == 2:
+        try:
+            cols, rows = int(parts[0]), int(parts[1])
+        except ValueError:
+            cols = rows = 0
+        if cols >= 1 and rows >= 1:
+            return rows, cols
+    raise ValueError(
+        f"grid must be 'auto' or CxR (tile columns x tile rows, "
+        f"e.g. 2x2), got {spec!r}"
+    )
+
+
+def _auto_grid(n: int, h: int, w: int) -> tuple[int, int]:
+    """The (rows, cols) tile layout for ``n`` workers on an ``h x w``
+    board: the largest m <= n with a factorization whose tiles fit
+    (rows <= h, cols <= w), breaking ties toward the squarest TILE —
+    minimal |log((h/rows) / (w/cols))| — so a square board gets a square
+    grid and a wide board gets proportionally more columns (the standard
+    TPU-torus block decomposition, arXiv:2112.09017)."""
+    for m in range(max(1, min(n, h * w)), 0, -1):
+        best = None
+        for rows in range(1, m + 1):
+            if m % rows:
+                continue
+            cols = m // rows
+            if rows > h or cols > w:
+                continue
+            skew = abs(math.log((h * cols) / (w * rows)))
+            if best is None or skew < best[1]:
+                best = ((rows, cols), skew)
+        if best is not None:
+            return best[0]
+    return 1, 1
+
+
+class _TilePlan:
+    """One seeded 2-D tile deployment (-grid): _ResidentPlan's
+    checkerboard twin. ``bounds[i] = (s, e, x0, x1)`` is the block of
+    board rows [s, e) x cols [x0, x1) held by ``active[i]``, laid out
+    row-major (flat index ``i = r * cols + c``). ``edges[i] = (top,
+    bottom, left, right)`` are the tile's UNPACKED boundary bands at the
+    committed turn, each ``k`` deep — enough for the broker to assemble
+    any neighbour's next halos INCLUDING the four K x K corner blocks
+    (tile (r, c)'s top-left corner is diagonal neighbour (r-1, c-1)'s
+    bottom band's last k columns), so corners never ride the uplink.
+    ``digests`` is the same per-block chain as _ResidentPlan."""
+
+    __slots__ = ("active", "bounds", "grid", "k", "edges", "digests")
+
+    def __init__(self, active, bounds, grid, k, edges, digests=None):
+        self.active = active
+        self.bounds = bounds
+        self.grid = grid  # (rows, cols)
+        self.k = k
+        self.edges = edges
+        self.digests = digests or [None] * len(active)
+
+
 class WorkersBackend:
     """Reference-shaped scatter/gather over remote workers
     (broker/broker.go:62-234).
@@ -282,11 +357,24 @@ class WorkersBackend:
         sync_interval: int = 256,
         ckpt_keep: int = 1,
         sparse_sync: bool = True,
+        grid: str | tuple[int, int] | None = None,
     ):
         if wire not in ("haloed", "full", "resident"):
             raise ValueError(
                 f"wire must be 'haloed', 'full' or 'resident', got {wire!r}"
             )
+        if isinstance(grid, str) or grid is None:
+            grid = parse_grid(grid)  # ValueError on malformed specs
+        elif not (
+            isinstance(grid, tuple)
+            and len(grid) == 2
+            and all(isinstance(v, int) and v >= 1 for v in grid)
+        ):
+            raise ValueError(f"grid must be 'auto' or (rows, cols), got {grid!r}")
+        if grid is not None and wire != "resident":
+            # tiles are a property of the stateful strip plane; the
+            # scatter/gather wires ship whole boards and have no layout
+            raise ValueError("grid tiling requires wire='resident'")
         if probe_interval <= 0:
             # 0 would busy-spin the probe thread and connect-storm every
             # dead address (next-probe times of now+0 forever)
@@ -300,6 +388,13 @@ class WorkersBackend:
             )
         self._wire = wire
         self._halo_depth = halo_depth  # resident batch depth K (server default)
+        # -grid: None | "auto" | (rows, cols); resolved per run against the
+        # board and roster into _run_grid (the active 2-D layout) or
+        # _grid_rows_forced (a one-column grid IS the strip plane — routed
+        # through the legacy loop with the row count pinned, byte-identical)
+        self._grid = grid
+        self._run_grid: tuple[int, int] | None = None  # turn-loop-local
+        self._grid_rows_forced: int | None = None  # turn-loop-local
         # resident mode: turns between periodic full re-syncs (bounds the
         # local recompute a loss recovery pays); 0 = only at snapshot/
         # pause/checkpoint/run-end boundaries and losses
@@ -408,6 +503,17 @@ class WorkersBackend:
         world = np.array(req.world, np.uint8, copy=True)
         h = world.shape[0]
         initial_turn = getattr(req, "initial_turn", 0)
+        # resolve the -grid layout for THIS run before any state changes:
+        # an un-layout-able roster is refused loudly (structured
+        # error_reason) instead of degenerately split
+        self._run_grid = None
+        self._grid_rows_forced = None
+        if self._wire == "resident" and self._grid is not None:
+            rows, cols = self._resolve_grid(req, h, world.shape[1])
+            if cols == 1:
+                self._grid_rows_forced = rows
+            else:
+                self._run_grid = (rows, cols)
         with self._lock:
             if self._running:
                 raise RpcError("a run is already in progress")
@@ -447,9 +553,42 @@ class WorkersBackend:
 
     def _turn_loop(self, req: Request, h: int, initial_turn: int = 0) -> None:
         if self._wire == "resident":
-            self._resident_turn_loop(req, h, initial_turn)
+            if self._run_grid is not None:
+                with self._lock:
+                    w = self._world.shape[1]
+                self._tile_turn_loop(req, h, w, initial_turn)
+            else:
+                self._resident_turn_loop(req, h, initial_turn)
         else:
             self._scatter_turn_loop(req, h, initial_turn)
+
+    def _resolve_grid(self, req: Request, h: int, w: int) -> tuple[int, int]:
+        """Resolve the configured -grid against this run's board and
+        roster. ``auto`` picks _auto_grid over the effective worker count;
+        an explicit grid that cannot be laid out is REFUSED with a
+        structured ``error_reason`` (grid_unsatisfiable: tiles would be
+        empty; grid_roster: not enough workers connected) rather than
+        degenerately split — the caller asked for a specific layout."""
+        with self._lock:
+            n_avail = len(self.clients)
+        if self._grid == "auto":
+            n = max(1, min(req.threads or n_avail, n_avail, h * w))
+            return _auto_grid(n, h, w)
+        rows, cols = self._grid
+        if rows > h or cols > w:
+            raise RpcError(
+                f"grid {cols}x{rows} cannot tile a {w}x{h} board: every "
+                f"tile needs at least one cell (grid rows <= board height "
+                f"and grid cols <= board width)",
+                reason="grid_unsatisfiable",
+            )
+        if rows * cols > n_avail:
+            raise RpcError(
+                f"grid {cols}x{rows} needs {rows * cols} workers, "
+                f"only {n_avail} connected",
+                reason="grid_roster",
+            )
+        return rows, cols
 
     def _scatter_turn_loop(self, req: Request, h: int, initial_turn: int = 0) -> None:
         """Per-turn scatter/gather with elastic recovery: a worker that dies
@@ -737,7 +876,7 @@ class WorkersBackend:
                 world, turn = self._world, self._turn
             if not active:
                 raise RpcError("all workers lost mid-run")
-            n = max(1, min(req.threads or len(active), len(active), h))
+            n = self._legacy_plan_n(req, len(active), h)
             active = active[:n]
             bounds = self._split(h, n)
             # the batch depth K: the -halo-depth knob clamped to the
@@ -772,9 +911,22 @@ class WorkersBackend:
                     if _integrity.enabled()
                     else None
                 )
+                if _metrics.enabled():
+                    # the strip plane IS the n x 1 tile layout
+                    _ins.TILE_GRID_ROWS.set(n)
+                    _ins.TILE_GRID_COLS.set(1)
+                    _ins.TILE_EDGE_CELLS.set(2 * k * world.shape[1])
                 return _ResidentPlan(active, bounds, k, edges, digests)
             for i in dead:
                 self._mark_lost(active[i], "resident seed failed")
+
+    def _legacy_plan_n(self, req, n_active: int, h: int) -> int:
+        """Worker count for a legacy strip plan. A -grid that resolved to
+        one column pins the row count (degrading only when the roster
+        shrank below it); otherwise today's threads-and-roster rule,
+        unchanged — the 1xN grid is byte-identical to the strip plane."""
+        want = self._grid_rows_forced or (req.threads or n_active)
+        return max(1, min(want, n_active, h))
 
     def _resident_sync(self, plan, pool, tp=None) -> bool:
         """Gather every resident strip (``StripFetch``) and refresh the
@@ -1020,7 +1172,7 @@ class WorkersBackend:
                     # current and reseed so the split RE-EXPANDS
                     with self._lock:
                         active = list(self.clients)
-                    n = max(1, min(req.threads or len(active), len(active), h))
+                    n = self._legacy_plan_n(req, len(active), h)
                     if active[:n] != plan.active:
                         if behind and not self._resident_sync(plan, pool):
                             self._resident_recover(plan, pool)
@@ -1049,6 +1201,7 @@ class WorkersBackend:
                 try:
                     deadline = self._scatter_deadline()
                     futures = []
+                    halo_bytes = 0  # strip halos are all row-axis traffic
                     for i in range(n):
                         # the worker's next halos are its neighbours'
                         # boundary rows at turn0: the strip above
@@ -1056,6 +1209,7 @@ class WorkersBackend:
                         # FIRST k (n == 1 wraps onto itself)
                         top = plan.edges[(i - 1) % n][1][-k:]
                         bottom = plan.edges[(i + 1) % n][0][:k]
+                        halo_bytes += top.nbytes + bottom.nbytes
                         req_i = Request(
                             world=np.concatenate([top, bottom], axis=0),
                             worker=i,
@@ -1196,6 +1350,7 @@ class WorkersBackend:
                         # shape/None-validated in the reply loop above;
                         # getattr keeps the read skew-safe regardless
                         edges = getattr(res, "edges", None)
+                        halo_bytes += edges.nbytes
                         plan.edges[i] = (edges[:k], edges[k:])
                         # advance the digest chain to the committed turn
                         # (None = this worker stopped attesting: the chain
@@ -1213,6 +1368,10 @@ class WorkersBackend:
                     # frontier gauge + the delta-checkpoint window
                     self._note_batch_dirty(results, plan, h)
                     _ins.TURN_BATCH_SIZE.observe(k)
+                    if _metrics.enabled():
+                        # committed batches only, both directions: the
+                        # strip plane's halos are entirely row traffic
+                        _ins.HALO_BYTES_TOTAL.labels("row").inc(halo_bytes)
                     if attribution:
                         # per-addr StripStep walls + critical-path gating
                         # (obs/critical.py) and the K-batch's dispatch-wall
@@ -1247,6 +1406,599 @@ class WorkersBackend:
                 if plan is None or not self._resident_sync(plan, pool):
                     if plan is not None:
                         self._resident_recover(plan, pool)
+            with self._lock:
+                self._control.notify_all()  # wake any sync-waiting retrieve
+            pool.shutdown(wait=False)
+
+    # -- the 2-D tile data plane (-grid) -----------------------------------
+
+    def _recompute_block(self, world, s, e, x0, x1, steps):
+        """_recompute_rows' 2-D twin: block [s, e) x [x0, x1) at ``steps``
+        turns past ``world``, stepped locally over the block's 2-D
+        dependency cone (``steps`` extra cells per side, toroidal wrap on
+        BOTH axes) with the workers' own non-wrapping tile kernel — so
+        the rebuild is bit-identical to what the lost tile's worker would
+        have computed."""
+        from .worker import _tile_step, compute_strip
+
+        h, w = world.shape
+        if (e - s) + 2 * steps >= h or (x1 - x0) + 2 * steps >= w:
+            # the cone wraps a full axis: plain full-board stepping is
+            # cheaper than a wider-than-the-board block
+            for _ in range(steps):
+                world = compute_strip(world, 0, h)
+            return world[s:e, x0:x1]
+        block = world[np.ix_(
+            np.arange(s - steps, e + steps) % h,
+            np.arange(x0 - steps, x1 + steps) % w,
+        )]
+        for _ in range(steps):
+            block = _tile_step(block)  # 2 fewer rows AND cols per step
+        return block
+
+    def _tile_seed(self, req, h: int, w: int, depth: int, pool, tp=None):
+        """_resident_seed's checkerboard twin: lay the current full board
+        out as the resolved rows x cols grid and ``StripStart`` every
+        tile (the grid extension fields mark the session 2-D; the worker
+        keeps the block resident). Loops over losses like the strip seed.
+        A roster that shrank below the grid mid-run degrades to the
+        squarest layout of the survivors — readmission drifts the roster
+        and reseeds back up. Returns None on quit."""
+        while True:
+            with self._lock:
+                if self._quit:
+                    return None
+                active = list(self.clients)
+                world, turn = self._world, self._turn
+            if not active:
+                raise RpcError("all workers lost mid-run")
+            rows, cols = self._run_grid
+            if rows * cols > len(active):
+                rows, cols = _auto_grid(len(active), h, w)
+            n = rows * cols
+            active = active[:n]
+            rbounds = self._split(h, rows)
+            cbounds = self._split(w, cols)
+            bounds = [
+                (s, e, x0, x1) for s, e in rbounds for x0, x1 in cbounds
+            ]
+            # the batch depth K clamps to the thinnest tile DIMENSION:
+            # corner halos are K x K blocks, so a tile cannot relay more
+            # edge cells than its shorter side holds
+            k = max(1, min(
+                depth,
+                min(e - s for s, e in rbounds),
+                min(x1 - x0 for x0, x1 in cbounds),
+            ))
+            deadline = self._scatter_deadline()
+            futures = [
+                pool.submit(
+                    self._call_worker,
+                    active[i],
+                    Methods.STRIP_START,
+                    Request(
+                        world=world[s:e, x0:x1],
+                        worker=i,
+                        initial_turn=turn,
+                        start_y=s,
+                        end_y=e,
+                        grid_rows=rows,
+                        grid_cols=cols,
+                        start_x=x0,
+                        end_x=x1,
+                    ),
+                    deadline,
+                    tp,
+                )
+                for i, (s, e, x0, x1) in enumerate(bounds)
+            ]
+            _, dead = self._bounded_gather(futures, deadline)
+            if not dead:
+                edges = [
+                    (
+                        world[s:s + k, x0:x1],
+                        world[e - k:e, x0:x1],
+                        world[s:e, x0:x0 + k],
+                        world[s:e, x1 - k:x1],
+                    )
+                    for s, e, x0, x1 in bounds
+                ]
+                # anchor the digest chain from the cells the broker
+                # itself sent — independent of anything the workers claim
+                digests = (
+                    [
+                        _integrity.state_digest(world[s:e, x0:x1])
+                        for s, e, x0, x1 in bounds
+                    ]
+                    if _integrity.enabled()
+                    else None
+                )
+                if _metrics.enabled():
+                    _ins.TILE_GRID_ROWS.set(rows)
+                    _ins.TILE_GRID_COLS.set(cols)
+                    th = max(e - s for s, e in rbounds)
+                    tw = max(x1 - x0 for x0, x1 in cbounds)
+                    _ins.TILE_EDGE_CELLS.set(2 * k * (th + tw) + 4 * k * k)
+                return _TilePlan(
+                    active, bounds, (rows, cols), k, edges, digests
+                )
+            for i in dead:
+                self._mark_lost(active[i], "tile seed failed")
+
+    def _tile_sync(self, plan, pool, tp=None) -> bool:
+        """_resident_sync for a tile plan: gather every resident tile
+        (``StripFetch``, dirty-tile deltas included — the PR 14 codec is
+        already 2-D) and reassemble the full board at the committed turn.
+        Same contract: True on success, False after marking failures or
+        diverged tiles lost."""
+        from ..ops import sparse as _sparse
+
+        with self._lock:
+            turn = self._turn
+            base_world, base_turn = self._world, self._sync_turn
+        self._sync_count += 1
+        use_delta = (
+            self._sparse_sync
+            and base_world is not None
+            and self._sync_count % _KEYFRAME_SYNCS != 0
+        )
+        delta_base = base_turn if use_delta else -1
+        deadline = self._scatter_deadline()
+        futures = [
+            pool.submit(
+                self._call_worker, c, Methods.STRIP_FETCH,
+                Request(worker=i, delta_base_turn=delta_base), deadline, tp,
+            )
+            for i, c in enumerate(plan.active)
+        ]
+        results, dead = self._bounded_gather(futures, deadline)
+        ok = True
+        for i in dead:
+            self._mark_lost(plan.active[i], "tile sync failed")
+            ok = False
+        tiles: list[np.ndarray | None] = [None] * len(plan.active)
+        for i, res in enumerate(results):
+            if res is None:
+                continue
+            s, e, x0, x1 = plan.bounds[i]
+            dirty = getattr(res, "dirty", None)
+            if isinstance(dirty, np.ndarray):
+                payload = np.asarray(res.work_slice, np.uint8)
+                try:
+                    tile = _sparse.apply_dirty_tiles(
+                        np.asarray(base_world[s:e, x0:x1], np.uint8),
+                        np.asarray(dirty, bool),
+                        payload,
+                    )
+                except (ValueError, IndexError, TypeError):
+                    self._mark_lost(plan.active[i], "tile delta malformed")
+                    ok = False
+                    continue
+                if _metrics.enabled():
+                    _ins.SPARSE_FRAME_BYTES_TOTAL.inc(
+                        payload.nbytes + dirty.size
+                    )
+            else:
+                tile = np.asarray(res.work_slice, np.uint8)
+            if res.turns_completed != turn or tile.shape != (e - s, x1 - x0):
+                self._mark_lost(plan.active[i], "tile lockstep divergence")
+                ok = False
+            elif plan.digests[i] is not None and _integrity.enabled():
+                _ins.INTEGRITY_CHECKS_TOTAL.inc()
+                if _integrity.state_digest(tile) != plan.digests[i]:
+                    self._integrity_suspect(
+                        plan, i, "fetch",
+                        f"fetched tile at turn {turn} does not match "
+                        "the committed digest chain",
+                    )
+                    self._mark_lost(
+                        plan.active[i], "tile fetch digest mismatch"
+                    )
+                    ok = False
+                else:
+                    tiles[i] = tile
+            else:
+                tiles[i] = tile
+        if not ok:
+            return False
+        # block assignment copies out of the receive-buffer views
+        # (protocol-5 sidecars), so the world outlives its frames; the
+        # last tile is the bottom-right block, so its bounds are (h, w)
+        world = np.empty((plan.bounds[-1][1], plan.bounds[-1][3]), np.uint8)
+        for i, (s, e, x0, x1) in enumerate(plan.bounds):
+            world[s:e, x0:x1] = tiles[i]
+        with self._lock:
+            self._world = world
+            self._sync_turn = turn
+        _ins.STRIP_RESYNC_TOTAL.inc()
+        return True
+
+    def _tile_recover(self, plan, pool, tp=None) -> None:
+        """_resident_recover over 2-D blocks: survivor tiles still AT the
+        committed turn contribute verbatim (digest-verified); blocks held
+        by lost workers — or survivors already past the commit — are
+        rebuilt locally through the 2-D dependency cone
+        (``_recompute_block``), bit-identical, bounded by
+        ``-sync-interval``."""
+        with self._lock:
+            base, t0, t1 = self._world, self._sync_turn, self._turn
+            alive = {id(c) for c in self.clients}
+        if t1 == t0:
+            return  # the loss landed at a boundary: world already current
+        parts: dict[int, np.ndarray] = {}
+        survivors = [
+            (i, c) for i, c in enumerate(plan.active) if id(c) in alive
+        ]
+        if survivors:
+            deadline = self._scatter_deadline()
+            futures = [
+                pool.submit(
+                    self._call_worker, c, Methods.STRIP_FETCH,
+                    Request(worker=i), deadline, tp,
+                )
+                for i, c in survivors
+            ]
+            results, dead = self._bounded_gather(futures, deadline)
+            for j in dead:
+                self._mark_lost(survivors[j][1], "tile recovery fetch failed")
+            for j, res in enumerate(results):
+                if res is None:
+                    continue
+                i = survivors[j][0]
+                s, e, x0, x1 = plan.bounds[i]
+                tile = np.asarray(res.work_slice, np.uint8)
+                if res.turns_completed == t1 and tile.shape == (e - s, x1 - x0):
+                    if plan.digests[i] is not None and _integrity.enabled():
+                        _ins.INTEGRITY_CHECKS_TOTAL.inc()
+                        if _integrity.state_digest(tile) != plan.digests[i]:
+                            self._integrity_suspect(
+                                plan, i, "fetch",
+                                f"survivor tile at turn {t1} does not "
+                                "match the committed digest chain",
+                            )
+                            self._mark_lost(
+                                plan.active[i],
+                                "tile recovery digest mismatch",
+                            )
+                            continue
+                    parts[i] = tile
+        world = np.empty_like(base)
+        steps = t1 - t0
+        for i, (s, e, x0, x1) in enumerate(plan.bounds):
+            if i in parts:
+                world[s:e, x0:x1] = parts[i]
+            else:
+                world[s:e, x0:x1] = self._recompute_block(
+                    base, s, e, x0, x1, steps
+                )
+        with self._lock:
+            self._world = world
+            self._sync_turn = t1
+        _ins.STRIP_RESYNC_TOTAL.inc()
+
+    def _tile_turn_loop(
+        self, req, h: int, w: int, initial_turn: int = 0
+    ) -> None:
+        """The resident loop over a 2-D checkerboard (-grid): tiles stay
+        on the workers, each K-turn batch moves the depth-K halos of all
+        four edges PLUS the four K x K corner blocks down (bit-packed —
+        the dependency cone of a K-step batch) and the four fresh edge
+        bands back up, so per-worker wire cost is O(K·(tile_h + tile_w))
+        instead of the strip plane's O(K·W), and the worker count is no
+        longer capped at H. Corners never ride the uplink: the broker
+        derives tile (r, c)'s next corner halos from its DIAGONAL
+        neighbours' row bands. Lockstep/sync/recovery/attestation
+        contracts are the strip loop's, generalized."""
+        import concurrent.futures
+
+        from .worker import (
+            _packed_len,
+            pack_tile_blocks,
+            tile_edge_shapes,
+            unpack_tile_blocks,
+        )
+
+        depth = getattr(req, "halo_depth", 0) or self._halo_depth
+        pool_size = max(1, len(self.clients), len(self.addresses))
+        pool = concurrent.futures.ThreadPoolExecutor(pool_size)
+        plan = None
+        try:
+            while True:
+                with self._lock:
+                    if self._quit:
+                        return
+                    paused = self._paused
+                    behind = self._sync_turn != self._turn
+                    done = self._turn >= req.turns
+                    want_sync = behind and (
+                        done
+                        or paused
+                        or self._sync_requested
+                        or self._ckpt_due()
+                        or (
+                            self._sync_interval
+                            and self._turn - self._sync_turn
+                            >= self._sync_interval
+                        )
+                    )
+                if want_sync:
+                    if plan is not None and not self._tile_sync(plan, pool):
+                        self._tile_recover(plan, pool)
+                        plan = None
+                    with self._lock:
+                        if self._sync_turn == self._turn:
+                            self._sync_requested = False
+                            self._control.notify_all()
+                    continue
+                if done:
+                    return
+                if paused:
+                    with self._lock:
+                        while self._paused and not self._quit:
+                            self._parked = True
+                            self._control.notify_all()
+                            self._control.wait()
+                        self._parked = False
+                        if self._quit:
+                            return
+                    continue
+                if plan is not None:
+                    # roster drift: readmission (or recovery from a
+                    # degraded layout) reseeds so the grid RE-EXPANDS
+                    with self._lock:
+                        active = list(self.clients)
+                    rows, cols = self._run_grid
+                    if rows * cols > len(active):
+                        rows, cols = _auto_grid(len(active), h, w)
+                    if (
+                        (rows, cols) != plan.grid
+                        or active[:rows * cols] != plan.active
+                    ):
+                        if behind and not self._tile_sync(plan, pool):
+                            self._tile_recover(plan, pool)
+                        plan = None
+                if plan is None:
+                    plan = self._tile_seed(req, h, w, depth, pool)
+                    if plan is None:
+                        return  # quit during seeding
+                    continue  # re-evaluate gates with the fresh plan
+
+                # -- one K-turn batch ----------------------------------
+                with self._lock:
+                    turn0 = self._turn
+                k = min(plan.k, req.turns - turn0)
+                n = len(plan.active)
+                rows, cols = plan.grid
+                turn_span = (
+                    _tracing.start_span(
+                        _tracing.SPAN_BROKER_TURN, turn=turn0, batch=k
+                    )
+                    if _tracing.enabled() else None
+                )
+                tp = turn_span.ctx() if turn_span else None
+                t_batch = time.monotonic()
+                attribution = self._attribution_on()
+                sink = [] if attribution else None
+                try:
+                    deadline = self._scatter_deadline()
+                    futures = []
+                    halo_row_b = halo_col_b = halo_corner_b = 0
+                    edge_shapes = [
+                        tile_edge_shapes(k, e - s, x1 - x0)
+                        for s, e, x0, x1 in plan.bounds
+                    ]
+                    for i in range(n):
+                        # tile (r, c)'s next halos at turn0: edge bands
+                        # from the four adjacent tiles, corner blocks cut
+                        # from the DIAGONAL neighbours' row bands (a 1-col
+                        # or 1-row grid wraps onto itself, same toroidal
+                        # rule as the strip plane's n == 1)
+                        r, c = divmod(i, cols)
+                        up = plan.edges[((r - 1) % rows) * cols + c]
+                        dn = plan.edges[((r + 1) % rows) * cols + c]
+                        lf = plan.edges[r * cols + (c - 1) % cols]
+                        rt = plan.edges[r * cols + (c + 1) % cols]
+                        tl = plan.edges[((r - 1) % rows) * cols + (c - 1) % cols]
+                        tr = plan.edges[((r - 1) % rows) * cols + (c + 1) % cols]
+                        bl = plan.edges[((r + 1) % rows) * cols + (c - 1) % cols]
+                        br = plan.edges[((r + 1) % rows) * cols + (c + 1) % cols]
+                        buf = pack_tile_blocks((
+                            up[1][-k:],       # top halo rows
+                            dn[0][:k],        # bottom halo rows
+                            lf[3][:, -k:],    # left halo cols
+                            rt[2][:, :k],     # right halo cols
+                            tl[1][-k:, -k:],  # top-left corner
+                            tr[1][-k:, :k],   # top-right corner
+                            bl[0][:k, -k:],   # bottom-left corner
+                            br[0][:k, :k],    # bottom-right corner
+                        ))
+                        sh = edge_shapes[i]
+                        halo_row_b += 2 * _packed_len(sh[0])
+                        halo_col_b += 2 * _packed_len(sh[2])
+                        halo_corner_b += 4 * _packed_len((k, k))
+                        req_i = Request(
+                            world=buf,
+                            worker=i,
+                            turns=k,
+                            initial_turn=turn0,
+                        )
+                        if sink is not None:
+                            futures.append(pool.submit(
+                                self._timed_call, plan.active[i],
+                                Methods.STRIP_STEP, req_i, deadline, tp,
+                                sink, i,
+                            ))
+                        else:
+                            futures.append(pool.submit(
+                                self._call_worker, plan.active[i],
+                                Methods.STRIP_STEP, req_i, deadline, tp,
+                            ))
+                    t_submitted = time.monotonic()
+                    results, dead = self._bounded_gather(futures, deadline)
+                    t_gathered = time.monotonic()
+                    check = _integrity.enabled()
+                    attests: list[dict | None] = [None] * n
+                    for i, res in enumerate(results):
+                        if res is None:
+                            continue
+                        edges = getattr(res, "edges", None)
+                        want = sum(_packed_len(sh) for sh in edge_shapes[i])
+                        if (
+                            res.turns_completed != turn0 + k
+                            or edges is None
+                            or getattr(edges, "ndim", 0) != 1
+                            or edges.size != want
+                        ):
+                            # a malformed success is a protocol violation
+                            dead.append(i)
+                            results[i] = None
+                            continue
+                        halo_row_b += 2 * _packed_len(edge_shapes[i][0])
+                        halo_col_b += 2 * _packed_len(edge_shapes[i][2])
+                        dig = getattr(res, "digests", None) if check else None
+                        if not isinstance(dig, dict):
+                            continue  # non-attesting peer: skew-safe skip
+                        _ins.INTEGRITY_CHECKS_TOTAL.inc()
+                        if (
+                            plan.digests[i] is not None
+                            and dig.get("pre") != plan.digests[i]
+                        ):
+                            self._integrity_suspect(
+                                plan, i, "strip",
+                                f"pre-batch tile digest at turn {turn0} "
+                                "does not match the committed chain",
+                            )
+                            dead.append(i)
+                            results[i] = None
+                            continue
+                        _ins.INTEGRITY_CHECKS_TOTAL.inc()
+                        if dig.get("edges") != _integrity.state_digest(edges):
+                            self._integrity_suspect(
+                                plan, i, "edges",
+                                "returned edge bands do not match their "
+                                "attested digest",
+                            )
+                            dead.append(i)
+                            results[i] = None
+                            continue
+                        attests[i] = dig
+                    # 2-D halo cross-attestation: every shared edge AND
+                    # corner is computed redundantly by both parties at
+                    # each shrinking step; four directed comparisons per
+                    # tile (up, left, and the two upward diagonals) cover
+                    # all eight adjacency relations grid-wide. A
+                    # disagreement cannot name the liar: BOTH parties are
+                    # quarantined, recovery rebuilds from the last
+                    # verified sync.
+                    suspects = set()
+                    pairs = (
+                        ("attest_top", -1, 0, "attest_bottom"),
+                        ("attest_left", 0, -1, "attest_right"),
+                        ("attest_tl", -1, -1, "attest_br"),
+                        ("attest_tr", -1, 1, "attest_bl"),
+                    )
+                    for i in range(n):
+                        if results[i] is None or attests[i] is None:
+                            continue
+                        r, c = divmod(i, cols)
+                        for mine, dr, dc, theirs in pairs:
+                            j = ((r + dr) % rows) * cols + (c + dc) % cols
+                            if results[j] is None or attests[j] is None:
+                                continue
+                            a = attests[i].get(mine)
+                            b = attests[j].get(theirs)
+                            if not a or not b:
+                                continue
+                            _ins.INTEGRITY_CHECKS_TOTAL.inc()
+                            if a != b:
+                                self._integrity_suspect(
+                                    plan, i, "attest",
+                                    f"{mine} band digests disagree with "
+                                    f"tile {j}'s {theirs} across the "
+                                    f"batch at turn {turn0}",
+                                )
+                                suspects.update((i, j))
+                    for i in suspects:
+                        dead.append(i)
+                        results[i] = None
+                    if dead:
+                        with self._lock:
+                            if self._quit:
+                                return  # shutdown race, not a failure
+                        for i in sorted(set(dead)):
+                            self._mark_lost(plan.active[i], "tile step failed")
+                        _ins.TURN_RETRY_TOTAL.inc()
+                        with self._lock:
+                            left = len(self.clients)
+                        logger.warning(
+                            "%d tile(s) lost mid-batch at turn %d; "
+                            "recovering over %d",
+                            len(set(dead)), turn0, left,
+                        )
+                        _journal.record(
+                            "recovery.resplit", "tile", turn=turn0,
+                            lost=len(set(dead)), remaining=left,
+                        )
+                        self._tile_recover(plan, pool, tp)
+                        plan = None
+                        continue
+                    # commit: lockstep advance, fresh edge bands only
+                    total = 0
+                    for res in results:
+                        counts = getattr(res, "counts", None) or []
+                        if counts:
+                            total += int(counts[-1])
+                    for i, res in enumerate(results):
+                        edges = getattr(res, "edges", None)
+                        plan.edges[i] = tuple(
+                            unpack_tile_blocks(edges, edge_shapes[i])
+                        )
+                        dig = getattr(res, "digests", None)
+                        plan.digests[i] = (
+                            dig.get("strip")
+                            if check and isinstance(dig, dict)
+                            else None
+                        )
+                    with self._lock:
+                        self._turn = turn0 + k
+                        self._record_alive(turn0 + k, total)
+                    self._note_batch_dirty(results, plan, h)
+                    _ins.TURN_BATCH_SIZE.observe(k)
+                    if _metrics.enabled():
+                        # committed batches, both directions, split by
+                        # axis — the O(K·edge) vs O(K·W) scaling claim is
+                        # measured, not asserted
+                        _ins.HALO_BYTES_TOTAL.labels("row").inc(halo_row_b)
+                        _ins.HALO_BYTES_TOTAL.labels("col").inc(halo_col_b)
+                        _ins.HALO_BYTES_TOTAL.labels("corner").inc(
+                            halo_corner_b
+                        )
+                    if attribution:
+                        self._feed_critical(
+                            sink, plan.active, turn0 + k, k, strip=True
+                        )
+                        self._observe_segments(
+                            t_submitted - t_batch,
+                            t_gathered - t_submitted,
+                            time.monotonic() - t_gathered,
+                            sink,
+                        )
+                finally:
+                    _tracing.end_span(turn_span)
+                dt = time.monotonic() - t_batch
+                self._turn_seconds = (
+                    dt if self._turn_seconds is None
+                    else 0.9 * self._turn_seconds + 0.1 * dt
+                )
+                _faults.fault_point("broker.turn_commit")
+                self._maybe_auto_checkpoint()
+        finally:
+            with self._lock:
+                behind = self._sync_turn != self._turn
+            if behind:
+                if plan is None or not self._tile_sync(plan, pool):
+                    if plan is not None:
+                        self._tile_recover(plan, pool)
             with self._lock:
                 self._control.notify_all()  # wake any sync-waiting retrieve
             pool.shutdown(wait=False)
@@ -1289,7 +2041,7 @@ class WorkersBackend:
         Turn-loop-local state only; no lock needed."""
         if not self._auto_checkpoint and not _metrics.enabled():
             return  # nobody consumes the bitmaps: keep the hot loop clean
-        from ..ops.sparse import WIRE_TILE_ROWS, wire_tile_grid
+        from ..ops.sparse import WIRE_TILE_COLS, WIRE_TILE_ROWS, wire_tile_grid
 
         total_dirty = 0
         known = True
@@ -1315,23 +2067,37 @@ class WorkersBackend:
         batch_dirty = np.zeros(grid_shape, bool)
         for i, res in enumerate(results):
             d = getattr(res, "dirty", None)
-            s, e = plan.bounds[i]
+            b = plan.bounds[i]
+            # strip bounds are (s, e); tile bounds carry the column band
+            # too, (s, e, x0, x1) — a full-width strip is x0=0, x1=width
+            s, e = b[0], b[1]
+            x0, x1 = (b[2], b[3]) if len(b) > 2 else (0, width)
             tis, tjs = np.nonzero(d)
             if not tis.size:
                 continue
-            # strip tile rows -> the global row bands they overlap
-            # (strips are full-width, so columns map 1:1). A strip tile
-            # is exactly WIRE_TILE_ROWS tall (ragged at the strip edge),
-            # so it spans at most TWO global bands — marking the first
-            # and last band covers the range, fully vectorized (the
-            # per-tile Python loop here measured as a real per-batch
-            # stall on big dirty grids)
+            # block tile rows/cols -> the global bands they overlap. A
+            # block tile is exactly WIRE_TILE_ROWS x WIRE_TILE_COLS
+            # (ragged at the block edge), so it spans at most TWO global
+            # bands per axis — marking the four corner band combinations
+            # covers the range, fully vectorized (the per-tile Python
+            # loop here measured as a real per-batch stall on big dirty
+            # grids). For full-width strips the column offset is zero and
+            # tiles align, so gc0 == gc1 == tjs: identical marks to the
+            # strip-only version
             r0 = s + tis * WIRE_TILE_ROWS
             r1 = np.minimum(
                 s + np.minimum((tis + 1) * WIRE_TILE_ROWS, e - s), e
             ) - 1
-            batch_dirty[r0 // WIRE_TILE_ROWS, tjs] = True
-            batch_dirty[r1 // WIRE_TILE_ROWS, tjs] = True
+            c0 = x0 + tjs * WIRE_TILE_COLS
+            c1 = np.minimum(
+                x0 + np.minimum((tjs + 1) * WIRE_TILE_COLS, x1 - x0), x1
+            ) - 1
+            gr0, gr1 = r0 // WIRE_TILE_ROWS, r1 // WIRE_TILE_ROWS
+            gc0, gc1 = c0 // WIRE_TILE_COLS, c1 // WIRE_TILE_COLS
+            batch_dirty[gr0, gc0] = True
+            batch_dirty[gr0, gc1] = True
+            batch_dirty[gr1, gc0] = True
+            batch_dirty[gr1, gc1] = True
         # the latest batch's own grid is kept separately: a full keyframe
         # captures the world at its SYNC turn, and this batch's changes
         # are already past it — they must seed the next window, not be
@@ -2258,6 +3024,7 @@ def serve(
     ckpt_keep: int = 1,
     session_capacity: int = 256,
     sparse_sync: bool = True,
+    grid: str | tuple[int, int] | None = None,
 ) -> tuple[RpcServer, BrokerService]:
     server = RpcServer(host=host, port=port)
     impl = (
@@ -2271,6 +3038,7 @@ def serve(
             sync_interval=sync_interval,
             ckpt_keep=ckpt_keep,
             sparse_sync=sparse_sync,
+            grid=grid,
         )
         if backend == "workers"
         else TpuBackend(halo_depth=halo_depth)
@@ -2326,6 +3094,16 @@ def main(argv=None) -> None:
              "re-syncs (bounds the local recompute a loss recovery pays; "
              "0 = only at snapshot/pause/checkpoint/run-end boundaries "
              "and losses)",
+    )
+    parser.add_argument(
+        "-grid", default=None, metavar="CxR|auto",
+        help="-wire resident: 2-D checkerboard worker layout — C tile "
+             "columns x R tile rows, width-by-height like the board "
+             "flags (1x4 is exactly four row strips, byte-identical to "
+             "the strip plane), or auto (squarest factorization of the "
+             "roster weighted by board aspect). Per-worker halo traffic "
+             "drops from O(K*W) to O(K*(tile_h+tile_w)) bit-packed "
+             "bytes per K-batch, and the H-row worker cap is gone",
     )
     parser.add_argument(
         "-sparse-sync", dest="sparse_sync", choices=("on", "off"),
@@ -2508,6 +3286,16 @@ def main(argv=None) -> None:
         parser.error("-sync-interval is a -wire resident knob")
     if args.sparse_sync != "on" and args.wire != "resident":
         parser.error("-sparse-sync is a -wire resident knob")
+    if args.grid is not None:
+        if args.backend != "workers" or args.wire != "resident":
+            parser.error(
+                "-grid is a workers-backend -wire resident knob "
+                "(the tpu backend lays out its own device mesh)"
+            )
+        try:
+            parse_grid(args.grid)
+        except ValueError as exc:
+            parser.error(str(exc))
     if args.rpc_deadline < 0:
         parser.error(f"-rpc-deadline must be >= 0, got {args.rpc_deadline}")
     if args.probe_interval <= 0:
@@ -2574,6 +3362,7 @@ def main(argv=None) -> None:
         ckpt_keep=args.ckpt_keep,
         session_capacity=args.session_capacity,
         sparse_sync=args.sparse_sync == "on",
+        grid=args.grid,
     )
     print(f"broker listening on :{server.port} (backend={args.backend})", flush=True)
     canary = None

@@ -158,6 +158,23 @@ class Request:
     # head — cadence, stacks, gc pauses — always rides). Same skew
     # posture: getattr, absent/0 = the full frame table.
     profile_since: int = 0
+    # extensions: the 2-D tile-resident data plane (-grid). On StripStart
+    # a nonzero ``grid_cols`` marks the seeded block as a TILE of an
+    # R x C checkerboard (grid_rows x grid_cols tile bands) spanning rows
+    # [start_y, end_y) x cols [start_x, end_x) of the board; StripStep
+    # then ships bit-packed four-edge-plus-corner halos in ``world``
+    # instead of the strip plane's 2K raw rows. getattr-read everywhere:
+    # a version-skewed older broker's pickle lacks the fields and every
+    # worker keeps serving plain 1-D row strips — and an EXPLICIT
+    # one-column grid never sets them at all (the broker routes it
+    # through the strip loop: the strip plane IS the C == 1 special
+    # case, byte-identical on the wire).
+    grid_rows: int = 0
+    grid_cols: int = 0
+    # the tile's column band [start_x, end_x) — start_y/end_y's column
+    # twins (those row fields are frozen Go-mirror base fields)
+    start_x: int = 0
+    end_x: int = 0
 
 
 @dataclasses.dataclass

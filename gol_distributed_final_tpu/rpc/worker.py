@@ -289,6 +289,344 @@ def _strip_batch_fused(padded, k: int, h: int, attest):
     return strip, counts
 
 
+#: Conway's masks, duplicated from ops/stencil.py so the numpy worker
+#: plane keeps its no-jax-at-import property (bit c set = the rule
+#: births/survives on c live neighbours)
+_CONWAY_BIRTH_MASK = 1 << 3
+_CONWAY_SURVIVE_MASK = (1 << 2) | (1 << 3)
+
+#: the 2-D attestation digest keys, fixed order — four edges plus the
+#: four diagonal corner bands a K-step dependency cone shares with the
+#: diagonal neighbours (see tile_step_batch)
+_TILE_ATTEST_KEYS = (
+    "attest_top", "attest_bottom", "attest_left", "attest_right",
+    "attest_tl", "attest_tr", "attest_bl", "attest_br",
+)
+
+
+def _tile_step(
+    padded: np.ndarray,
+    birth_mask: int = _CONWAY_BIRTH_MASK,
+    survive_mask: int = _CONWAY_SURVIVE_MASK,
+) -> np.ndarray:
+    """(h, w) padded block -> (h-2, w-2) next interior, NO wrap on either
+    axis — the 2-D tile kernel (``_strip_step`` minus the local column
+    wrap: a tile's column neighbours are OTHER workers' tiles, so its
+    left/right context arrives as halo data exactly like its rows). Same
+    deliberate numpy posture as ``_strip_step``. Masked rules ride for
+    the oracle tests (HighLife parity); the resident wire itself stays
+    Conway-only (the broker refuses other rules on it)."""
+    b = (padded != 0).astype(np.uint8)
+    counts = (
+        b[:-2, :-2].astype(np.int32) + b[:-2, 1:-1] + b[:-2, 2:]
+        + b[1:-1, :-2] + b[1:-1, 2:]
+        + b[2:, :-2] + b[2:, 1:-1] + b[2:, 2:]
+    )
+    alive = b[1:-1, 1:-1] == 1
+    if birth_mask == _CONWAY_BIRTH_MASK and survive_mask == _CONWAY_SURVIVE_MASK:
+        next_alive = np.where(alive, (counts == 2) | (counts == 3), counts == 3)
+    else:
+        lut = np.array(
+            [[(survive_mask if a else birth_mask) >> c & 1 for c in range(9)]
+             for a in (0, 1)],
+            bool,
+        )
+        next_alive = lut[alive.astype(np.intp), counts]
+    return np.where(next_alive, 255, 0).astype(np.uint8)
+
+
+def _packed_len(shape) -> int:
+    """Bytes one bit-packed cell block of this shape occupies on the
+    tile halo wire."""
+    return (int(shape[0]) * int(shape[1]) + 7) // 8
+
+
+def pack_tile_blocks(blocks) -> np.ndarray:
+    """Bit-pack 0/255 cell blocks (1 bit per cell) into one flat uint8
+    buffer — the tile halo wire format. Each block packs SEPARATELY
+    (byte-aligned), so section offsets derive from shapes alone
+    (``tile_halo_shapes``/``tile_edge_shapes``) and per-axis byte counts
+    are exact for the ``gol_halo_bytes_total{axis}`` meter. The 8x
+    reduction vs raw uint8 cells is what puts a 2-D grid's
+    edge-plus-corner exchange strictly under the strip plane's row-only
+    bytes even at the 2x2 break-even point of a square board. Lossless:
+    halo cells only feed the nonzero-is-alive kernel, and every block a
+    worker computes is already 0/255."""
+    if not blocks:
+        return np.zeros(0, np.uint8)
+    return np.concatenate(
+        [np.packbits((np.asarray(b, np.uint8) != 0).ravel()) for b in blocks]
+    )
+
+
+def unpack_tile_blocks(buf, shapes) -> list:
+    """Inverse of ``pack_tile_blocks`` given the section shapes. Strict:
+    a short buffer or trailing bytes is a protocol violation (raises),
+    never a silent truncation."""
+    buf = np.asarray(buf, np.uint8).ravel()
+    out, off = [], 0
+    for sh in shapes:
+        n = int(sh[0]) * int(sh[1])
+        ln = _packed_len(sh)
+        seg = buf[off : off + ln]
+        if seg.size != ln:
+            raise ValueError(
+                f"tile buffer truncated: section {sh} needs {ln} bytes, "
+                f"{buf.size - off} left"
+            )
+        cells = np.unpackbits(seg, count=n).astype(np.uint8) * np.uint8(255)
+        out.append(cells.reshape((int(sh[0]), int(sh[1]))))
+        off += ln
+    if off != buf.size:
+        raise ValueError(f"tile buffer has {buf.size - off} trailing bytes")
+    return out
+
+
+def tile_halo_shapes(k: int, th: int, tw: int) -> list:
+    """Downlink (StripStep ``world``) section shapes for a depth-K tile
+    batch, fixed order: top, bottom (k x tile_w row bands), left, right
+    (tile_h x k column bands), then the four K x K corner blocks
+    (tl, tr, bl, br) — the full dependency cone of K steps."""
+    return [
+        (k, tw), (k, tw), (th, k), (th, k),
+        (k, k), (k, k), (k, k), (k, k),
+    ]
+
+
+def tile_edge_shapes(k: int, th: int, tw: int) -> list:
+    """Uplink (reply ``edges``) section shapes: the stepped tile's fresh
+    top, bottom, left, right bands. No corners — the broker derives each
+    diagonal corner block from the diagonal neighbour's row bands."""
+    return [(k, tw), (k, tw), (th, k), (th, k)]
+
+
+def tile_step_batch(
+    tile: np.ndarray,
+    halos,
+    k: int,
+    attest: bool = False,
+    *,
+    mode: str = "auto",
+    rule=None,
+):
+    """Advance a resident 2-D TILE K turns from its four depth-K edge
+    halos plus four K x K corner blocks — ``strip_step_batch``'s
+    checkerboard generalisation, shrinking one cell per SIDE per step:
+    the (th + 2K) x (tw + 2K) block lands exactly on the K-turns-later
+    tile. ``halos`` is the 8-tuple ``(top, bottom, left, right, tl, tr,
+    bl, br)`` in ``tile_halo_shapes`` order. Returns ``(next_tile,
+    per_step_alive_counts)`` — counts cover the TILE's cells only, so
+    the roster's sum is the whole board's count per turn, exactly like
+    strips.
+
+    ``attest=True`` additionally returns a dict of EIGHT rolling band
+    digests (``_TILE_ATTEST_KEYS``): after step j (off = K - j) the
+    top/bottom digests fold the block's first/last ``2*off`` rows over
+    its full current width, left/right its first/last ``2*off`` columns
+    over its full height, and the four corner digests the ``2*off x
+    2*off`` corner sub-blocks. Two tiles sharing an edge compute that
+    band redundantly from the same turn-t inputs, and diagonal
+    neighbours likewise share a corner cone, so the broker cross-checks
+    ``(r,c).attest_top == (r-1,c).attest_bottom``, ``.attest_left ==
+    (r,c-1).attest_right``, ``.attest_tl == (r-1,c-1).attest_br`` and
+    ``.attest_tr == (r-1,c+1).attest_bl`` (toroidal indices; a 1-band
+    axis self-pairs, which still compares — the wrap makes both bands
+    the same board cells). Disagreement quarantines BOTH parties, same
+    contract as the strip plane's two-band attestation.
+
+    Routing mirrors the strip batch minus the fused path: ``skip`` steps
+    only the live frontier's K-deep 2-D bounding window between zero
+    pads (exact for non-B0 rules by the same dead-stays-dead + discarded
+    garbage-cone argument, now per axis), ``dense`` is the plain loop.
+    There is deliberately NO fused tile path: ops/fused's strip kernel
+    wraps columns locally, which a tile must not — GOL_WORKER_FUSED=on
+    therefore pins big TILE batches to dense, not to a wrong kernel.
+    ``rule`` is an optional LifeRule-shaped object (birth_mask/
+    survive_mask) for oracle tests; the wire plane never sets it."""
+    th, tw = tile.shape
+    if k < 1:
+        raise ValueError(f"tile batch needs k >= 1, got {k}")
+    if k > min(th, tw):
+        raise ValueError(
+            f"batch depth {k} exceeds tile minimum dimension {min(th, tw)}"
+        )
+    top, bottom, left, right, tl, tr, bl, br = halos
+    for name, arr, want in (
+        ("top", top, (k, tw)), ("bottom", bottom, (k, tw)),
+        ("left", left, (th, k)), ("right", right, (th, k)),
+        ("tl", tl, (k, k)), ("tr", tr, (k, k)),
+        ("bl", bl, (k, k)), ("br", br, (k, k)),
+    ):
+        if np.asarray(arr).shape != want:
+            raise ValueError(
+                f"depth-{k} tile halo {name} must be {want}, got "
+                f"{np.asarray(arr).shape}"
+            )
+    birth = rule.birth_mask if rule is not None else _CONWAY_BIRTH_MASK
+    survive = rule.survive_mask if rule is not None else _CONWAY_SURVIVE_MASK
+    block = np.block([
+        [np.asarray(tl, np.uint8), np.asarray(top, np.uint8), np.asarray(tr, np.uint8)],
+        [np.asarray(left, np.uint8), np.asarray(tile, np.uint8), np.asarray(right, np.uint8)],
+        [np.asarray(bl, np.uint8), np.asarray(bottom, np.uint8), np.asarray(br, np.uint8)],
+    ])
+    window = None
+    if mode == "auto":
+        if birth & 1:
+            mode = "dense"  # B0: dead cells birth — no dead band exists
+        else:
+            window = _live_window_2d(block, k)
+            area = (window[1] - window[0]) * (window[3] - window[2])
+            if area <= _SKIP_MAX_WINDOW_FRAC * block.size:
+                mode = "skip"
+            else:
+                mode = "dense"
+    if mode == "fused":
+        raise ValueError(
+            "tile batches have no fused path: ops/fused's strip kernel "
+            "wraps columns locally (a tile's column context is halo "
+            "data); use auto/dense/skip"
+        )
+    if mode == "skip":
+        if birth & 1:
+            raise ValueError("the dead-band skip is unsound under a B0 rule")
+        if window is None:  # pinned mode: the routing scan never ran
+            window = _live_window_2d(block, k)
+        return _tile_batch_skip(block, k, th, tw, window, attest, birth, survive)
+    if mode != "dense":
+        raise ValueError(f"unknown tile batch mode {mode!r}")
+    counts = []
+    states = {key: _integrity.state_new() for key in _TILE_ATTEST_KEYS}
+    for i in range(k):
+        block = _tile_step(block, birth, survive)  # 2 fewer rows AND cols
+        off = k - (i + 1)
+        counts.append(int(np.count_nonzero(block[off : off + th, off : off + tw])))
+        if attest:
+            _fold_tile_bands(states, block, 2 * off)
+    if attest:
+        return (
+            block, counts,
+            {key: _integrity.state_hex(st) for key, st in states.items()},
+        )
+    return block, counts
+
+
+def _fold_tile_bands(states, block, band: int):
+    """Fold one step's eight attestation bands into the rolling digests
+    (band = 2*(K-j) cells per side; empty at the final step — the fold
+    still binds the shape header, so the step structure is pinned)."""
+    H, W = block.shape
+    states["attest_top"] = _integrity.state_add(states["attest_top"], block[:band])
+    states["attest_bottom"] = _integrity.state_add(
+        states["attest_bottom"], block[H - band :]
+    )
+    states["attest_left"] = _integrity.state_add(
+        states["attest_left"], block[:, :band]
+    )
+    states["attest_right"] = _integrity.state_add(
+        states["attest_right"], block[:, W - band :]
+    )
+    states["attest_tl"] = _integrity.state_add(
+        states["attest_tl"], block[:band, :band]
+    )
+    states["attest_tr"] = _integrity.state_add(
+        states["attest_tr"], block[:band, W - band :]
+    )
+    states["attest_bl"] = _integrity.state_add(
+        states["attest_bl"], block[H - band :, :band]
+    )
+    states["attest_br"] = _integrity.state_add(
+        states["attest_br"], block[H - band :, W - band :]
+    )
+
+
+def _live_window_2d(block: np.ndarray, k: int):
+    """The live frontier's K-deep dependency cone as a 2-D window
+    (r0, r1, c0, c1) — ``_live_window`` per axis. Cells outside it are
+    dead at turn t AND at distance > K from any live cell on BOTH axes,
+    so they stay dead through all K steps under any non-B0 rule.
+    All-zeros when the whole block is dead."""
+    rows = np.flatnonzero(block.any(axis=1))
+    if rows.size == 0:
+        return 0, 0, 0, 0
+    cols = np.flatnonzero(block.any(axis=0))
+    return (
+        max(0, int(rows[0]) - k),
+        min(block.shape[0], int(rows[-1]) + 1 + k),
+        max(0, int(cols[0]) - k),
+        min(block.shape[1], int(cols[-1]) + 1 + k),
+    )
+
+
+def _tile_batch_skip(block, k, th, tw, window, attest, birth, survive):
+    """The dead-band skip in 2-D: step ONLY the live window between zero
+    pads, reconstruct every full-block artifact (tile, counts, all eight
+    attestation bands) from it. Exactness is the strip argument per
+    axis: outside the window is provably dead for all K steps, and where
+    the window touches the BLOCK's edge the zero pad stands in for cone
+    data the dense shrinking form also discards — the garbage reaches at
+    most ``j-1`` cells in from that edge by step j, strictly outside the
+    tile region and that step's bands (which start ``j`` cells in)."""
+    H, W = block.shape
+    r0, r1, c0, c1 = window
+    active = np.array(block[r0:r1, c0:c1], np.uint8)
+
+    def materialize(a: int, b: int, c: int, d: int) -> np.ndarray:
+        out = np.zeros((max(0, b - a), max(0, d - c)), np.uint8)
+        rlo, rhi = max(a, r0), min(b, r1)
+        clo, chi = max(c, c0), min(d, c1)
+        if rhi > rlo and chi > clo:
+            out[rlo - a : rhi - a, clo - c : chi - c] = active[
+                rlo - r0 : rhi - r0, clo - c0 : chi - c0
+            ]
+        return out
+
+    counts = []
+    states = {key: _integrity.state_new() for key in _TILE_ATTEST_KEYS}
+    for i in range(k):
+        if active.size:
+            # constant-size: the zero ring replaces the cells the dense
+            # shrinking form consumes (provably dead, or discarded cone)
+            padded = np.zeros((active.shape[0] + 2, active.shape[1] + 2), np.uint8)
+            padded[1:-1, 1:-1] = active
+            active = _tile_step(padded, birth, survive)
+        step = i + 1
+        off = k - step
+        rlo, rhi = max(k, r0), min(k + th, r1)
+        clo, chi = max(k, c0), min(k + tw, c1)
+        counts.append(
+            int(np.count_nonzero(
+                active[rlo - r0 : rhi - r0, clo - c0 : chi - c0]
+            ))
+            if rhi > rlo and chi > clo
+            else 0
+        )
+        if attest:
+            band = 2 * off
+            # the shrunk block's bands in original-frame coordinates:
+            # at step j the dense block occupies [j, H-j) x [j, W-j)
+            shadow = {
+                "attest_top": (step, step + band, step, W - step),
+                "attest_bottom": (H - step - band, H - step, step, W - step),
+                "attest_left": (step, H - step, step, step + band),
+                "attest_right": (step, H - step, W - step - band, W - step),
+                "attest_tl": (step, step + band, step, step + band),
+                "attest_tr": (step, step + band, W - step - band, W - step),
+                "attest_bl": (H - step - band, H - step, step, step + band),
+                "attest_br": (
+                    H - step - band, H - step, W - step - band, W - step,
+                ),
+            }
+            for key, box in shadow.items():
+                states[key] = _integrity.state_add(states[key], materialize(*box))
+    final = materialize(k, k + th, k, k + tw)
+    if attest:
+        return (
+            final, counts,
+            {key: _integrity.state_hex(st) for key, st in states.items()},
+        )
+    return final, counts
+
+
 class WorkerService:
     # the resident-strip session state moves as one unit under its lock
     # (machine-enforced: analysis/locks.py flags any access outside
@@ -299,6 +637,7 @@ class WorkerService:
         "_strip_index": "_strip_lock",
         "_strip_dirty": "_strip_lock",
         "_strip_clean_turn": "_strip_lock",
+        "_strip_is_tile": "_strip_lock",
     }
 
     def __init__(self, server: RpcServer):
@@ -320,6 +659,11 @@ class WorkerService:
         # anything else degrades to a full frame.
         self._strip_dirty: np.ndarray | None = None
         self._strip_clean_turn = 0
+        # True when the resident block is a 2-D TILE (-grid with >= 2
+        # column bands): StripStep then ships bit-packed four-edge-plus-
+        # corner halos instead of the strip plane's 2K raw rows. A
+        # 1-column grid never sets it — the strip plane IS that case.
+        self._strip_is_tile = False
 
     def update(self, req: Request) -> Response:
         # chaos hook (rpc/faults.py): GOL_FAULT_POINTS can wedge, crash, or
@@ -356,10 +700,18 @@ class WorkerService:
             raise ValueError(f"strip must be a 2-D row block, got {strip.shape}")
         from ..ops.sparse import wire_tile_grid
 
+        grid_cols = getattr(req, "grid_cols", 0)
         with self._strip_lock:
             self._strip = strip
             turn = self._strip_turn = getattr(req, "initial_turn", 0)
             self._strip_index = req.worker
+            # a nonzero column-band count marks a 2-D tile session — the
+            # legacy strip loop never sets the field, and a broker's tile
+            # loop may degrade a shrunken roster to a one-column grid that
+            # still speaks the tile wire (getattr-read: a version-skewed
+            # broker's pickle lacks the field and this worker keeps
+            # serving plain 1-D strips)
+            self._strip_is_tile = isinstance(grid_cols, int) and grid_cols >= 1
             # the broker just sent this full strip, so its copy IS
             # current: a clean dirty accumulator anchored at the seed turn
             self._strip_dirty = np.zeros(wire_tile_grid(strip.shape), bool)
@@ -397,16 +749,31 @@ class WorkerService:
                     f"strip index mismatch: seeded as {self._strip_index}, "
                     f"stepped as {req.worker}"
                 )
-            halos = np.asarray(req.world, np.uint8)
-            if halos.shape != (2 * k, self._strip.shape[1]):
-                raise ValueError(
-                    f"depth-{k} halos must be ({2 * k}, "
-                    f"{self._strip.shape[1]}), got {halos.shape}"
-                )
-            if k > self._strip.shape[0]:
-                raise ValueError(
-                    f"batch depth {k} exceeds strip height {self._strip.shape[0]}"
-                )
+            halo_blocks = None
+            if self._strip_is_tile:
+                if k < 1:
+                    raise ValueError(f"tile batch needs k >= 1, got {k}")
+                th, tw = self._strip.shape
+                buf = np.asarray(req.world, np.uint8).ravel()
+                shapes = tile_halo_shapes(k, th, tw)
+                want = sum(_packed_len(s) for s in shapes)
+                if buf.size != want:
+                    raise ValueError(
+                        f"depth-{k} tile halos for a {th}x{tw} tile must "
+                        f"pack to {want} bytes, got {buf.size}"
+                    )
+                halo_blocks = unpack_tile_blocks(buf, shapes)
+            else:
+                halos = np.asarray(req.world, np.uint8)
+                if halos.shape != (2 * k, self._strip.shape[1]):
+                    raise ValueError(
+                        f"depth-{k} halos must be ({2 * k}, "
+                        f"{self._strip.shape[1]}), got {halos.shape}"
+                    )
+                if k > self._strip.shape[0]:
+                    raise ValueError(
+                        f"batch depth {k} exceeds strip height {self._strip.shape[0]}"
+                    )
             # chaos site (rpc/faults.py "corrupt" action): flips a byte of
             # the RESIDENT strip in place — the silent-state-corruption
             # fault the digest chain below exists to catch. Placed before
@@ -416,7 +783,15 @@ class WorkerService:
             check = _integrity.enabled()
             pre = _integrity.state_digest(self._strip) if check else None
             pre_strip = self._strip
-            if check:
+            att = None
+            if self._strip_is_tile:
+                if check:
+                    strip, counts, att = tile_step_batch(
+                        self._strip, halo_blocks, k, attest=True
+                    )
+                else:
+                    strip, counts = tile_step_batch(self._strip, halo_blocks, k)
+            elif check:
                 strip, counts, att_top, att_bottom = strip_step_batch(
                     self._strip, halos[:k], halos[k:], k, attest=True
                 )
@@ -441,25 +816,37 @@ class WorkerService:
                 self._strip_dirty = dirty.copy()
             self._strip = strip
             self._strip_turn += k
-            # the fresh boundary rows: the broker relays them to this
-            # strip's neighbours as their next batch's halos — the only
-            # state that leaves this process per batch
-            edges = np.concatenate([strip[:k], strip[-k:]], axis=0)
+            # the fresh boundary bands: the broker relays them to this
+            # block's neighbours as their next batch's halos — the only
+            # state that leaves this process per batch. A tile ships all
+            # four edges bit-packed (the broker derives corner blocks
+            # from the diagonal neighbours' row bands, so corners never
+            # ride the uplink).
+            if self._strip_is_tile:
+                edges = pack_tile_blocks(
+                    (strip[:k], strip[-k:], strip[:, :k], strip[:, -k:])
+                )
+            else:
+                edges = np.concatenate([strip[:k], strip[-k:]], axis=0)
             digests = None
             if check:
                 # the attestation payload (rpc/integrity.py): "pre"/"strip"
                 # anchor the broker's per-strip digest chain (in-place
                 # corruption between batches is caught on the NEXT step),
                 # "edges" covers worker-side serialisation of the reply
-                # rows, and the attest digests feed the neighbour
-                # cross-check
+                # bands (for a tile, the PACKED buffer — what actually
+                # crosses), and the attest digests feed the neighbour
+                # cross-check (two for a strip, eight for a tile)
                 digests = {
                     "pre": pre,
                     "strip": _integrity.state_digest(strip),
                     "edges": _integrity.state_digest(edges),
-                    "attest_top": att_top,
-                    "attest_bottom": att_bottom,
                 }
+                if self._strip_is_tile:
+                    digests.update(att)
+                else:
+                    digests["attest_top"] = att_top
+                    digests["attest_bottom"] = att_bottom
             turn_done = self._strip_turn
         # journal outside the strip lock (one record per K-turn batch):
         # this worker's half of the chunk the broker is about to commit

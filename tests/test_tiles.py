@@ -1,0 +1,519 @@
+"""2-D tile data-plane suite: the checkerboard grid over the resident wire.
+
+Covers the layers ``-grid`` stands on:
+
+* ``rpc/worker.py`` tile kernel — ``tile_step_batch`` oracle parity from
+  the four depth-K edge halos plus four K×K corner blocks (the full 2-D
+  dependency cone), the bit-packed halo wire format (``pack_tile_blocks``
+  round-trip, strict truncation errors), the 2-D dead-band skip route,
+  masked-rule (HighLife) parity, and the eight-band attestation payload.
+* ``rpc/broker.py`` tile sessions — bit-parity against the wrapping
+  oracle across grids × batch depths × uneven splits, the squarest-fit
+  ``auto`` resolver and its gauges, the H-cap removal (8 workers on a
+  4-row board via 2x4), structured roster refusals, byte-identity of an
+  explicit one-column grid with the legacy strip plane, the 2-D
+  cross-attestation BOTH-quarantine contract, and one-tile loss recovery.
+* ``obs/regress.py`` — the deterministic halo-byte gate beside the wire
+  gate, and ``analysis/skew.py`` auto-discovering the tile wire fields.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gol_distributed_final_tpu.models.life import CONWAY, HIGHLIFE
+from gol_distributed_final_tpu.obs import metrics as obs_metrics
+from gol_distributed_final_tpu.rpc import worker as rpc_worker
+from gol_distributed_final_tpu.rpc.broker import (
+    WorkersBackend,
+    _auto_grid,
+    parse_grid,
+)
+from gol_distributed_final_tpu.rpc.client import RpcError
+from gol_distributed_final_tpu.rpc.protocol import Methods, Request
+from gol_distributed_final_tpu.rpc.worker import (
+    pack_tile_blocks,
+    tile_edge_shapes,
+    tile_halo_shapes,
+    tile_step_batch,
+    unpack_tile_blocks,
+    _packed_len,
+)
+
+from oracle import vector_step
+
+
+def _rand_board(h, w, seed, density=0.4):
+    rng = np.random.default_rng(seed)
+    return np.where(rng.random((h, w)) < density, 255, 0).astype(np.uint8)
+
+
+def _lut_step(board, rule):
+    """Wrapping one-step oracle for an arbitrary masked rule."""
+    b = (board != 0).astype(np.int32)
+    n = sum(
+        np.roll(np.roll(b, dr, 0), dc, 1)
+        for dr in (-1, 0, 1) for dc in (-1, 0, 1)
+    ) - b
+    nxt = np.where(b == 1, (rule.survive_mask >> n) & 1, (rule.birth_mask >> n) & 1)
+    return np.where(nxt.astype(bool), 255, 0).astype(np.uint8)
+
+
+def _wrap_halos(board, s, e, x0, x1, k):
+    """The 8-tuple (top, bottom, left, right, tl, tr, bl, br) a broker
+    would relay for the tile ``board[s:e, x0:x1]`` — toroidal indices."""
+    h, w = board.shape
+
+    def rs(a, b):
+        return np.arange(a, b) % h
+
+    def cs(a, b):
+        return np.arange(a, b) % w
+
+    return (
+        board[np.ix_(rs(s - k, s), cs(x0, x1))],
+        board[np.ix_(rs(e, e + k), cs(x0, x1))],
+        board[np.ix_(rs(s, e), cs(x0 - k, x0))],
+        board[np.ix_(rs(s, e), cs(x1, x1 + k))],
+        board[np.ix_(rs(s - k, s), cs(x0 - k, x0))],
+        board[np.ix_(rs(s - k, s), cs(x1, x1 + k))],
+        board[np.ix_(rs(e, e + k), cs(x0 - k, x0))],
+        board[np.ix_(rs(e, e + k), cs(x1, x1 + k))],
+    )
+
+
+# -- tile kernel --------------------------------------------------------------
+
+
+@pytest.mark.parametrize("k", [1, 3])
+def test_tile_step_batch_matches_oracle_shrinking_form(k):
+    board = _rand_board(24, 18, seed=k)
+    s, e, x0, x1 = 8, 14, 6, 12
+    tile = board[s:e, x0:x1].copy()
+    got, counts = tile_step_batch(tile, _wrap_halos(board, s, e, x0, x1, k), k)
+    want = board.copy()
+    per_step = []
+    for _ in range(k):
+        want = vector_step(want)
+        per_step.append(int(np.count_nonzero(want[s:e, x0:x1])))
+    np.testing.assert_array_equal(got, want[s:e, x0:x1])
+    assert counts == per_step
+
+
+def test_tile_step_batch_highlife_parity():
+    """The masked-rule path: B36/S23 through the same shrinking cone —
+    and the seed genuinely exercises B6 (HighLife diverges from Conway)."""
+    board = _rand_board(20, 20, seed=77, density=0.45)
+    s, e, x0, x1 = 5, 13, 4, 14
+    k = 3
+    want_hl, want_cw = board.copy(), board.copy()
+    for _ in range(k):
+        want_hl = _lut_step(want_hl, HIGHLIFE)
+        want_cw = _lut_step(want_cw, CONWAY)
+    assert not np.array_equal(want_hl, want_cw), "seed never fired B6"
+    np.testing.assert_array_equal(want_cw[:], vector_step(
+        vector_step(vector_step(board))
+    ))  # the LUT oracle agrees with the Conway oracle on Conway
+    got, _counts = tile_step_batch(
+        board[s:e, x0:x1].copy(), _wrap_halos(board, s, e, x0, x1, k), k,
+        rule=HIGHLIFE,
+    )
+    np.testing.assert_array_equal(got, want_hl[s:e, x0:x1])
+
+
+def test_tile_skip_route_matches_dense_and_fused_refuses():
+    """A lone glider deep inside an otherwise dead tile: the 2-D dead-band
+    skip must reproduce the dense result AND all eight attestation
+    digests; ``mode='fused'`` is an explicit refusal (the fused strip
+    kernel wraps columns locally, which a tile must not)."""
+    board = np.zeros((40, 40), np.uint8)
+    board[10:13, 10:13] = np.where(
+        np.array([[0, 1, 0], [0, 0, 1], [1, 1, 1]]), 255, 0
+    ).astype(np.uint8)
+    s, e, x0, x1 = 4, 36, 4, 36
+    k = 4
+    halos = _wrap_halos(board, s, e, x0, x1, k)
+    tile = board[s:e, x0:x1]
+    d_tile, d_counts, d_att = tile_step_batch(
+        tile.copy(), halos, k, attest=True, mode="dense"
+    )
+    s_tile, s_counts, s_att = tile_step_batch(
+        tile.copy(), halos, k, attest=True, mode="skip"
+    )
+    np.testing.assert_array_equal(s_tile, d_tile)
+    assert s_counts == d_counts
+    assert s_att == d_att
+    with pytest.raises(ValueError, match="no fused path"):
+        tile_step_batch(tile.copy(), halos, k, mode="fused")
+
+
+def test_pack_unpack_roundtrip_and_strict_errors():
+    k, th, tw = 3, 7, 11  # odd cell counts: partial trailing bytes
+    shapes = tile_halo_shapes(k, th, tw)
+    rng = np.random.default_rng(5)
+    blocks = [
+        np.where(rng.random(sh) < 0.5, 255, 0).astype(np.uint8)
+        for sh in shapes
+    ]
+    buf = pack_tile_blocks(blocks)
+    assert buf.size == sum(_packed_len(sh) for sh in shapes)
+    for got, want in zip(unpack_tile_blocks(buf, shapes), blocks):
+        np.testing.assert_array_equal(got, want)
+    with pytest.raises(ValueError, match="truncated"):
+        unpack_tile_blocks(buf[:-1], shapes)
+    with pytest.raises(ValueError, match="trailing"):
+        unpack_tile_blocks(np.concatenate([buf, buf[:1]]), shapes)
+    assert tile_edge_shapes(k, th, tw) == [(k, tw), (k, tw), (th, k), (th, k)]
+
+
+def test_tile_batch_depth_exceeding_thinnest_dimension_refuses():
+    tile = np.zeros((4, 9), np.uint8)
+    halos = tuple(np.zeros(sh, np.uint8) for sh in tile_halo_shapes(5, 4, 9))
+    with pytest.raises(ValueError, match="exceeds tile minimum dimension"):
+        tile_step_batch(tile, halos, 5)
+
+
+def test_worker_tile_session_validates_packed_halo_buffer():
+    """A StripStart carrying grid fields flips the session to the tile
+    wire: StripStep then demands the exact packed halo byte count and
+    replies with packed edges plus the eight-band attestation digests."""
+    service = rpc_worker.WorkerService(server=None)
+    tile = _rand_board(8, 10, seed=3)
+    service.strip_start(Request(
+        world=tile.copy(), worker=0, initial_turn=0,
+        grid_rows=2, grid_cols=2, start_x=0, end_x=10,
+    ))
+    k = 2
+    shapes = tile_halo_shapes(k, 8, 10)
+    with pytest.raises(ValueError, match="must pack to"):
+        service.strip_step(Request(
+            world=np.zeros(3, np.uint8), turns=k, worker=0, initial_turn=0,
+        ))
+    halos = pack_tile_blocks([np.zeros(sh, np.uint8) for sh in shapes])
+    res = service.strip_step(Request(
+        world=halos, turns=k, worker=0, initial_turn=0,
+    ))
+    assert res.turns_completed == k
+    assert res.edges.size == sum(
+        _packed_len(sh) for sh in tile_edge_shapes(k, 8, 10)
+    )
+    assert {"attest_tl", "attest_tr", "attest_bl", "attest_br"} <= set(
+        res.digests
+    )
+
+
+# -- grid resolution ----------------------------------------------------------
+
+
+def test_parse_grid_and_auto_resolver():
+    assert parse_grid("auto") == "auto"
+    assert parse_grid("2x2") == (2, 2)
+    assert parse_grid("2x4") == (4, 2)  # CxR: 2 columns, 4 rows
+    for bad in ("3x", "x3", "0x2", "2x-1", "nope"):
+        with pytest.raises(ValueError):
+            parse_grid(bad)
+    assert _auto_grid(4, 32, 32) == (2, 2)  # square board: squarest split
+    assert _auto_grid(4, 4, 400) == (1, 4)  # wide board: column bands
+    assert _auto_grid(3, 400, 4) == (3, 1)  # tall board: row bands
+    assert _auto_grid(1, 8, 8) == (1, 1)
+
+
+def test_grid_requires_resident_wire_and_valid_spec():
+    with pytest.raises(ValueError, match="resident"):
+        WorkersBackend(["127.0.0.1:1"], wire="haloed", grid="2x2")
+    with pytest.raises(ValueError):
+        WorkersBackend(["127.0.0.1:1"], wire="resident", grid="3x")
+
+
+# -- in-process cluster -------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tile_cluster():
+    """Nine in-process workers — enough for the 3x3 grid."""
+    servers = [rpc_worker.serve(port=0) for _ in range(9)]
+    yield [f"127.0.0.1:{s.port}" for s, _ in servers]
+    for server, _service in servers:
+        server.stop()
+
+
+@pytest.fixture
+def live_metrics():
+    obs_metrics.enable()
+    obs_metrics.registry().reset()
+    yield obs_metrics
+    obs_metrics.enable(False)
+
+
+def _counter(name):
+    for fam in obs_metrics.registry().snapshot()["families"]:
+        if fam["name"] == name:
+            return {tuple(s["labels"]): s["value"] for s in fam["series"]}
+    return {}
+
+
+def _gauge(name):
+    vals = list(_counter(name).values())
+    return vals[0] if vals else None
+
+
+def _run_grid(addrs, board, turns, k, grid, sync_interval=16, **kw):
+    backend = WorkersBackend(
+        addrs, wire="resident", halo_depth=k, sync_interval=sync_interval,
+        grid=grid, **kw,
+    )
+    try:
+        return backend.run(
+            Request(
+                world=board, turns=turns, threads=len(addrs),
+                image_width=board.shape[1], image_height=board.shape[0],
+            )
+        )
+    finally:
+        backend.close()
+
+
+_ORACLE_CACHE = {}
+
+
+def _oracle(board, turns):
+    key = (board.tobytes(), board.shape, turns)
+    if key not in _ORACLE_CACHE:
+        want = board.copy()
+        for _ in range(turns):
+            want = vector_step(want)
+        _ORACLE_CACHE[key] = want
+    return _ORACLE_CACHE[key]
+
+
+@pytest.mark.parametrize("grid", ["1x4", "4x1", "2x2", "3x3", "2x4"])
+@pytest.mark.parametrize("k", [1, 4, 8])
+def test_tile_parity_vs_oracle(tile_cluster, grid, k):
+    """Bit-identical to the wrapping oracle across the grid matrix: both
+    orientations, squares, the 8-worker 2x4, uneven splits on BOTH axes
+    (24 % 3, 33 % 2, 33 % 3 all nonzero), partial final batches
+    (41 % 4 != 0), and the per-grid K clamp to the thinnest band."""
+    board = _rand_board(24, 33, seed=2433)
+    turns = 41
+    res = _run_grid(tile_cluster, board, turns, k, grid)
+    assert res.turns_completed == turns
+    np.testing.assert_array_equal(res.world, _oracle(board, turns))
+
+
+def test_tile_auto_grid_squarest_fit_and_gauges(tile_cluster, live_metrics):
+    """``-grid auto`` on a square board with 4 requested lanes resolves
+    2x2 (the squarest factorization), publishes the grid gauges, and
+    meters halo bytes on all three axes."""
+    board = _rand_board(32, 32, seed=9)
+    turns = 16
+    backend = WorkersBackend(
+        tile_cluster, wire="resident", halo_depth=4, sync_interval=16,
+        grid="auto",
+    )
+    try:
+        res = backend.run(
+            Request(world=board, turns=turns, threads=4,
+                    image_width=32, image_height=32)
+        )
+    finally:
+        backend.close()
+    np.testing.assert_array_equal(res.world, _oracle(board, turns))
+    assert _gauge("gol_tile_grid_rows") == 2
+    assert _gauge("gol_tile_grid_cols") == 2
+    assert _gauge("gol_tile_edge_cells") == 2 * 4 * (16 + 16) + 4 * 16
+    halo = _counter("gol_halo_bytes_total")
+    for axis in ("row", "col", "corner"):
+        assert halo.get((axis,), 0) > 0, f"axis={axis} never metered"
+
+
+def test_tile_grid_eight_workers_on_four_row_board(tile_cluster):
+    """The H-cap removal: a 4-row board can ONLY split 4 ways as strips,
+    but 2x4 puts 8 workers on it (1x20 tiles; K clamps to 1)."""
+    board = _rand_board(4, 40, seed=440)
+    turns = 17
+    res = _run_grid(tile_cluster, board, turns, 4, "2x4")
+    assert res.turns_completed == turns
+    np.testing.assert_array_equal(res.world, _oracle(board, turns))
+
+
+def test_tile_corner_glider_cone_exact(tile_cluster):
+    """A glider crossing the 2x2 junction diagonally mid-K-batch: its
+    light cone enters the next tile through the K×K CORNER block, so
+    parity here is exactness of the corner-halo geometry."""
+    board = np.zeros((16, 16), np.uint8)
+    board[5:8, 5:8] = np.where(
+        np.array([[0, 1, 0], [0, 0, 1], [1, 1, 1]]), 255, 0
+    ).astype(np.uint8)
+    turns = 24
+    res = _run_grid(tile_cluster, board, turns, 8, "2x2")
+    np.testing.assert_array_equal(res.world, _oracle(board, turns))
+
+
+def test_grid_rejections_are_structured(tile_cluster):
+    board = _rand_board(24, 33, seed=1)
+    with pytest.raises(RpcError) as ei:
+        _run_grid(tile_cluster[:4], board, 8, 4, "3x3")
+    assert ei.value.reason == "grid_roster"
+    with pytest.raises(RpcError) as ei:
+        _run_grid(tile_cluster[:4], _rand_board(1, 40, seed=2), 8, 4, "2x2")
+    assert ei.value.reason == "grid_unsatisfiable"
+
+
+def test_one_column_grid_is_wire_byte_identical(tile_cluster, live_metrics):
+    """``-grid 1x4`` IS the strip plane: same loop, same frames — the
+    run's gol_wire_bytes_total delta matches a plain 4-lane resident run
+    EXACTLY, byte for byte."""
+    board = _rand_board(64, 64, seed=64)
+    turns = 48
+
+    def run(grid):
+        backend = WorkersBackend(
+            tile_cluster, wire="resident", halo_depth=4, sync_interval=16,
+            grid=grid,
+        )
+        try:
+            b0 = sum(_counter("gol_wire_bytes_total").values())
+            res = backend.run(
+                Request(world=board, turns=turns, threads=4,
+                        image_width=64, image_height=64)
+            )
+            return res, sum(_counter("gol_wire_bytes_total").values()) - b0
+        finally:
+            backend.close()
+
+    res_plain, bytes_plain = run(None)
+    res_grid, bytes_grid = run("1x4")
+    np.testing.assert_array_equal(res_plain.world, res_grid.world)
+    np.testing.assert_array_equal(res_grid.world, _oracle(board, turns))
+    assert bytes_grid == bytes_plain, (
+        f"1x4 moved {bytes_grid} B, plain strips {bytes_plain} B"
+    )
+
+
+def test_tile_attestation_mismatch_quarantines_both(live_metrics):
+    """The 2-D cross-attestation contract: one worker's tampered
+    attest_top digest disagrees with its up-neighbour's attest_bottom —
+    the broker cannot name the liar, so BOTH tiles quarantine
+    (gol_worker_lost_total >= 2, gol_integrity_failures_total{attest}),
+    recovery rebuilds from the last verified sync, and the finished
+    board is still bit-identical to the oracle."""
+    servers = [rpc_worker.serve(port=0) for _ in range(4)]
+    addrs = [f"127.0.0.1:{s.port}" for s, _ in servers]
+    state = {"armed": True}
+    orig = servers[1][1].strip_step
+
+    def tampered(req):
+        res = orig(req)
+        d = getattr(res, "digests", None)
+        if (
+            state["armed"] and isinstance(d, dict) and "attest_top" in d
+            and res.turns_completed >= 60
+        ):
+            d["attest_top"] = "00" * 16
+            state["armed"] = False
+        return res
+
+    servers[1][0].register(Methods.STRIP_STEP, tampered)
+    board = _rand_board(48, 48, seed=13)
+    turns = 600
+    try:
+        res = _run_grid(
+            addrs, board, turns, 4, "2x2", sync_interval=16,
+            rpc_deadline=2.0, probe_interval=0.2,
+        )
+        assert res.turns_completed == turns
+        np.testing.assert_array_equal(res.world, _oracle(board, turns))
+        assert not state["armed"], "the tamper never fired"
+        assert _counter("gol_integrity_failures_total").get(("attest",), 0) >= 1
+        assert sum(_counter("gol_worker_lost_total").values()) >= 2, (
+            "a band disagreement must quarantine BOTH parties"
+        )
+    finally:
+        for server, _service in servers:
+            server.stop()
+
+
+def test_tile_worker_loss_recovers_bit_identical():
+    """Kill one tile's server mid-run: the broker rebuilds the lost block
+    at the committed turn (survivor fetches + the 2-D modular dependency
+    cone recompute), re-splits the grid over the survivors, and the
+    final board is bit-identical to the oracle."""
+    servers = [rpc_worker.serve(port=0) for _ in range(4)]
+    addrs = [f"127.0.0.1:{s.port}" for s, _ in servers]
+    board = _rand_board(48, 48, seed=17)
+    turns = 1200
+    backend = WorkersBackend(
+        addrs, wire="resident", halo_depth=4, sync_interval=32,
+        grid="2x2", rpc_deadline=2.0, probe_interval=0.2,
+    )
+    out = {}
+    t = threading.Thread(
+        target=lambda: out.update(
+            r=backend.run(
+                Request(world=board, turns=turns, threads=4,
+                        image_width=48, image_height=48)
+            )
+        )
+    )
+    t.start()
+    try:
+        deadline = time.monotonic() + 60
+        while backend.retrieve(include_world=False).turns_completed < 150:
+            assert time.monotonic() < deadline, "run never got going"
+            time.sleep(0.002)
+        servers[1][0].stop()  # mid-batch tile loss
+        t.join(timeout=120)
+        assert not t.is_alive(), "run hung after the loss"
+        assert out["r"].turns_completed == turns
+        np.testing.assert_array_equal(out["r"].world, _oracle(board, turns))
+    finally:
+        if t.is_alive():
+            backend.quit()
+            t.join(timeout=30)
+        backend.close()
+        for server, _service in servers:
+            try:
+                server.stop()
+            except Exception:
+                pass
+
+
+# -- gates and skew safety ----------------------------------------------------
+
+
+def test_bench_diff_gates_halo_bytes_not_just_wall_clock():
+    """``scripts/bench_diff`` (obs/regress.py): a case whose
+    ``halo_bytes_per_turn`` grew past the threshold REGRESSES even when
+    wall-clock is clean — the same deterministic posture as the wire-byte
+    gate, on the tile plane's own meter."""
+    from gol_distributed_final_tpu.obs.regress import compare_case
+
+    base = {
+        "per_turn_us": 100.0, "spread_s": 0.001, "n_lo": 100, "n_hi": 1100,
+        "halo_bytes_per_turn": 520.0,
+    }
+    same = compare_case(base, dict(base))
+    assert same["verdict"] == "jitter"
+    assert same["halo_bytes_delta_pct"] == 0.0
+    bloated = compare_case(base, dict(base, halo_bytes_per_turn=700.0))
+    assert bloated["verdict"] == "REGRESSED"
+    assert "halo" in bloated["why"]
+    slimmer = compare_case(base, dict(base, halo_bytes_per_turn=100.0))
+    assert slimmer["verdict"] == "jitter"  # a comms WIN never gates
+    plain = compare_case(
+        {k: v for k, v in base.items() if k != "halo_bytes_per_turn"},
+        {k: v for k, v in base.items() if k != "halo_bytes_per_turn"},
+    )
+    assert "halo_bytes_delta_pct" not in plain
+
+
+def test_skew_checker_auto_discovers_tile_wire_fields():
+    """The tile grid fields ride protocol.py as extension fields — the
+    skew-safety checker's AST parse must pick them up WITHOUT a manual
+    registry edit (the PR 7 contract)."""
+    from gol_distributed_final_tpu.analysis.skew import wire_extension_fields
+
+    req_ext, _res_ext = wire_extension_fields()
+    assert {"grid_rows", "grid_cols", "start_x", "end_x"} <= set(req_ext)
